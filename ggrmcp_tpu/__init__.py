@@ -1,0 +1,28 @@
+"""ggrmcp_tpu — a TPU-native gRPC↔MCP gateway + JAX serving framework.
+
+A brand-new framework with the capability surface of the ggRMCP reference
+(a Go gRPC→MCP gateway; see SURVEY.md): it discovers gRPC backends via
+server reflection or FileDescriptorSets, generates JSON-Schema'd MCP tools
+from protobuf descriptors, and transcodes MCP JSON-RPC tool calls into
+dynamic gRPC invocations — with sessions, header policy, validation,
+sanitization, health and metrics.
+
+Unlike the reference, the backends are TPU-served JAX models: a serving
+plane (`ggrmcp_tpu.serving`) exposes jit/pjit-sharded models (BERT
+embeddings, Llama-family generation) over gRPC with continuous batching,
+so MCP tool calls resolve to XLA programs on TPU slices.
+
+Layout:
+  core/      config tree, method model, sessions, header policy
+  mcp/       JSON-RPC 2.0 / MCP wire types, validation, sanitization
+  schema/    protobuf descriptor → JSON Schema engine (tensor-aware)
+  rpc/       reflection client+server, descriptor loading, discovery,
+             connection pool with health checking
+  gateway/   HTTP front door: handler, middleware chain, metrics
+  models/    JAX model definitions (BERT, Llama) — pure functional
+  ops/       Pallas kernels + core ops (flash attention, ring attention)
+  parallel/  mesh construction, sharding specs, collective helpers
+  serving/   TPU serving sidecar: engine, KV cache, continuous batching
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,452 @@
+"""Whole-app configuration tree.
+
+Capability parity with the reference config system (pkg/config/config.go)
+with the gaps deliberately fixed (SURVEY.md §5.6): the tree here is
+actually *plumbed* — every subsystem takes its config slice — and it
+loads from defaults → YAML/JSON file → environment → CLI overrides,
+whereas the reference defined the tree but only ever used two fields.
+
+Defaults mirror the reference's canonical values (config.go:211-312):
+HTTP 50053, 4 MB gRPC messages, keepalive 10 s/5 s, reconnect 5×5 s,
+protocol 2024-11-05, sessions 30 min / 10 k, schema max depth 10 — plus
+the TPU sections (mesh/serving/batching) that have no reference analogue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+# ---------------------------------------------------------------------------
+# Server / HTTP
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SecurityConfig:
+    enable_security_headers: bool = True
+    hsts: bool = True
+    content_security_policy: str = "default-src 'none'"
+
+
+@dataclass
+class CORSConfig:
+    enabled: bool = True
+    allowed_origins: list[str] = field(default_factory=lambda: ["*"])
+    allowed_methods: list[str] = field(
+        default_factory=lambda: ["GET", "POST", "OPTIONS"]
+    )
+    allowed_headers: list[str] = field(
+        default_factory=lambda: ["Content-Type", "Mcp-Session-Id", "Authorization"]
+    )
+    exposed_headers: list[str] = field(default_factory=lambda: ["Mcp-Session-Id"])
+
+
+@dataclass
+class RateLimitConfig:
+    enabled: bool = True
+    requests_per_second: float = 100.0
+    burst: int = 200
+
+
+@dataclass
+class ServerConfig:
+    host: str = "0.0.0.0"
+    port: int = 50053
+    read_timeout_s: float = 15.0
+    write_timeout_s: float = 15.0
+    idle_timeout_s: float = 60.0
+    request_timeout_s: float = 30.0
+    max_request_bytes: int = 1 << 20  # 1 MB
+    shutdown_grace_s: float = 30.0
+    allowed_content_types: list[str] = field(
+        default_factory=lambda: ["application/json"]
+    )
+    security: SecurityConfig = field(default_factory=SecurityConfig)
+    cors: CORSConfig = field(default_factory=CORSConfig)
+    rate_limit: RateLimitConfig = field(default_factory=RateLimitConfig)
+
+
+# ---------------------------------------------------------------------------
+# gRPC upstream(s)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class KeepAliveConfig:
+    time_s: float = 10.0
+    timeout_s: float = 5.0
+    permit_without_stream: bool = True
+
+
+@dataclass
+class ReconnectConfig:
+    """Background reconnect policy.
+
+    The reference defined Reconnect (pkg/grpc/discovery.go:187-235) but
+    never invoked it at runtime; here a background watchdog actually
+    drives it (SURVEY.md §5.3 'deliberately fix').
+    """
+
+    enabled: bool = True
+    max_attempts: int = 5
+    interval_s: float = 5.0
+    watchdog_interval_s: float = 10.0
+
+
+@dataclass
+class HeaderForwardingConfig:
+    enabled: bool = True
+    forward_all: bool = False
+    case_insensitive: bool = True
+    allowed_headers: list[str] = field(
+        default_factory=lambda: [
+            "authorization",
+            "x-trace-id",
+            "x-request-id",
+            "x-user-id",
+            "x-api-key",
+            "user-agent",
+            "accept-language",
+        ]
+    )
+    blocked_headers: list[str] = field(
+        default_factory=lambda: [
+            "cookie",
+            "set-cookie",
+            "host",
+            "content-length",
+            "content-type",
+            "connection",
+            "upgrade",
+            "proxy-authorization",
+            "proxy-authenticate",
+            "te",
+            "trailer",
+            "transfer-encoding",
+            "mcp-session-id",
+        ]
+    )
+
+
+@dataclass
+class DescriptorSetConfig:
+    enabled: bool = False
+    path: str = ""
+    prefer_over_reflection: bool = True
+    include_source_info: bool = True
+
+
+@dataclass
+class GRPCConfig:
+    host: str = "localhost"
+    port: int = 50051
+    max_message_bytes: int = 4 << 20  # 4 MB
+    connect_timeout_s: float = 5.0
+    call_timeout_s: float = 30.0
+    use_tls: bool = False
+    keepalive: KeepAliveConfig = field(default_factory=KeepAliveConfig)
+    reconnect: ReconnectConfig = field(default_factory=ReconnectConfig)
+    header_forwarding: HeaderForwardingConfig = field(
+        default_factory=HeaderForwardingConfig
+    )
+    descriptor_set: DescriptorSetConfig = field(default_factory=DescriptorSetConfig)
+
+    @property
+    def target(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+# ---------------------------------------------------------------------------
+# MCP protocol
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ValidationConfig:
+    max_method_length: int = 1024
+    max_tool_name_length: int = 128
+    max_nesting_depth: int = 10
+    max_request_bytes: int = 1 << 20
+
+
+@dataclass
+class MCPConfig:
+    protocol_version: str = "2024-11-05"
+    server_name: str = "ggrmcp-tpu"
+    server_version: str = "0.1.0"
+    validation: ValidationConfig = field(default_factory=ValidationConfig)
+
+
+# ---------------------------------------------------------------------------
+# Sessions
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SessionRateLimitConfig:
+    """Per-session fixed-window limit — and unlike the reference
+    (pkg/session/manager.go:178, never called), the handler enforces it."""
+
+    enabled: bool = True
+    requests_per_minute: int = 100
+
+
+@dataclass
+class SessionConfig:
+    ttl_s: float = 1800.0  # 30 min
+    cleanup_interval_s: float = 300.0  # 5 min
+    max_sessions: int = 10_000
+    rate_limit: SessionRateLimitConfig = field(default_factory=SessionRateLimitConfig)
+
+
+# ---------------------------------------------------------------------------
+# Tools / schema generation
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SchemaCacheConfig:
+    """Schema cache — configured AND implemented (the reference declared
+    this but never wired it; pkg/tools/builder.go:18)."""
+
+    enabled: bool = True
+    max_entries: int = 4096
+
+
+@dataclass
+class ToolsConfig:
+    max_schema_depth: int = 10
+    emit_output_schema: bool = True
+    include_comments: bool = True
+    tensor_extensions: bool = True  # x-tensor dtype/shape hints in schemas
+    cache: SchemaCacheConfig = field(default_factory=SchemaCacheConfig)
+
+
+# ---------------------------------------------------------------------------
+# TPU serving plane (no reference analogue — new capability)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MeshConfig:
+    """Logical device mesh for the serving plane.
+
+    Axis sizes of 0 mean "infer from available devices". Axes follow the
+    scaling-book convention: data / fsdp / tensor / sequence / expert /
+    stage(pipeline).
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 0  # 0 → all remaining devices
+    sequence: int = 1
+    expert: int = 1
+    stage: int = 1
+    allow_cpu_fallback: bool = True
+
+
+@dataclass
+class BatchingConfig:
+    max_batch_size: int = 32
+    max_queue_delay_ms: float = 5.0
+    max_decode_steps: int = 512
+    prefill_chunk: int = 512
+    kv_cache_max_seq: int = 4096
+
+
+@dataclass
+class ServingConfig:
+    model: str = "tiny-llama"  # registry key in ggrmcp_tpu.models
+    dtype: str = "bfloat16"
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    batching: BatchingConfig = field(default_factory=BatchingConfig)
+    port: int = 50051
+    checkpoint_path: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Logging / observability
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LoggingConfig:
+    level: str = "info"
+    development: bool = False
+    json_output: bool = True
+
+
+@dataclass
+class MetricsConfig:
+    enabled: bool = True
+    prometheus: bool = True  # real text-format metrics, not a JSON stub
+
+
+# ---------------------------------------------------------------------------
+# Root
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Config:
+    server: ServerConfig = field(default_factory=ServerConfig)
+    grpc: GRPCConfig = field(default_factory=GRPCConfig)
+    mcp: MCPConfig = field(default_factory=MCPConfig)
+    session: SessionConfig = field(default_factory=SessionConfig)
+    tools: ToolsConfig = field(default_factory=ToolsConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
+    logging: LoggingConfig = field(default_factory=LoggingConfig)
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+
+    # -- validation ---------------------------------------------------------
+
+    def validate(self) -> None:
+        """Raise ValueError on nonsense values (config.go:328-357 parity)."""
+        if not (0 < self.server.port < 65536):
+            raise ValueError(f"invalid HTTP port: {self.server.port}")
+        if not (0 < self.grpc.port < 65536):
+            raise ValueError(f"invalid gRPC port: {self.grpc.port}")
+        if self.server.request_timeout_s <= 0:
+            raise ValueError("request timeout must be positive")
+        if self.grpc.connect_timeout_s <= 0:
+            raise ValueError("gRPC connect timeout must be positive")
+        if self.grpc.max_message_bytes <= 0:
+            raise ValueError("gRPC max message size must be positive")
+        if self.session.max_sessions <= 0:
+            raise ValueError("session capacity must be positive")
+        if self.tools.max_schema_depth <= 0:
+            raise ValueError("schema depth must be positive")
+        if self.grpc.descriptor_set.enabled and not self.grpc.descriptor_set.path:
+            raise ValueError("descriptor set enabled but no path given")
+
+
+def default() -> Config:
+    return Config()
+
+
+def development() -> Config:
+    """Development overrides (config.go:315-325 parity)."""
+    cfg = Config()
+    cfg.logging.level = "debug"
+    cfg.logging.development = True
+    cfg.logging.json_output = False
+    cfg.server.rate_limit.enabled = False
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Loading: defaults → file → env → overrides
+# ---------------------------------------------------------------------------
+
+
+def _merge(obj: Any, data: dict[str, Any], path: str = "") -> None:
+    for key, value in data.items():
+        attr = key.replace("-", "_")
+        if not hasattr(obj, attr):
+            raise ValueError(f"unknown config key: {path}{key}")
+        current = getattr(obj, attr)
+        if dataclasses.is_dataclass(current) and isinstance(value, dict):
+            _merge(current, value, f"{path}{key}.")
+        else:
+            if current is not None and not isinstance(value, type(current)):
+                # Allow int→float promotion, nothing else silently.
+                if isinstance(current, float) and isinstance(value, int):
+                    value = float(value)
+                elif isinstance(current, bool) != isinstance(value, bool):
+                    raise ValueError(
+                        f"config key {path}{key}: expected "
+                        f"{type(current).__name__}, got {type(value).__name__}"
+                    )
+            setattr(obj, attr, value)
+
+
+def load_file(path: str, base: Optional[Config] = None) -> Config:
+    """Load YAML or JSON config over the defaults."""
+    cfg = base or default()
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        data = yaml.safe_load(text) or {}
+    else:
+        data = json.loads(text or "{}")
+    _merge(cfg, data)
+    return cfg
+
+
+_ENV_PREFIX = "GGRMCP_"
+
+
+def apply_env(cfg: Config, environ: Optional[dict[str, str]] = None) -> Config:
+    """Apply GGRMCP_SECTION_KEY=value environment overrides.
+
+    E.g. GGRMCP_SERVER_PORT=8080, GGRMCP_GRPC_HOST=tpu-vm-1,
+    GGRMCP_SERVING_MODEL=llama3-8b. Nested paths use single underscores
+    resolved greedily against the config tree.
+    """
+    environ = environ if environ is not None else dict(os.environ)
+    for key, raw in environ.items():
+        if not key.startswith(_ENV_PREFIX):
+            continue
+        parts = key[len(_ENV_PREFIX) :].lower().split("_")
+        _apply_env_path(cfg, parts, raw, key)
+    return cfg
+
+
+def _apply_env_path(obj: Any, parts: list[str], raw: str, orig: str) -> None:
+    # Greedy match: join as many parts as needed to hit an attribute.
+    for take in range(len(parts), 0, -1):
+        attr = "_".join(parts[:take])
+        if hasattr(obj, attr):
+            current = getattr(obj, attr)
+            rest = parts[take:]
+            if dataclasses.is_dataclass(current):
+                if not rest:
+                    raise ValueError(f"{orig}: points at a section, not a value")
+                _apply_env_path(current, rest, raw, orig)
+            else:
+                if rest:
+                    continue  # try a shorter attr match
+                setattr(obj, attr, _coerce(current, raw, orig))
+            return
+    raise ValueError(f"unknown config env var: {orig}")
+
+
+def _coerce(current: Any, raw: str, orig: str) -> Any:
+    if isinstance(current, bool):
+        if raw.lower() in ("1", "true", "yes", "on"):
+            return True
+        if raw.lower() in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"{orig}: expected boolean, got {raw!r}")
+    if isinstance(current, int):
+        return int(raw)
+    if isinstance(current, float):
+        return float(raw)
+    if isinstance(current, list):
+        return [item.strip() for item in raw.split(",") if item.strip()]
+    return raw
+
+
+def load(
+    path: Optional[str] = None,
+    env: bool = True,
+    overrides: Optional[dict[str, Any]] = None,
+    dev: bool = False,
+) -> Config:
+    """Full load pipeline: defaults → file → env → explicit overrides."""
+    cfg = development() if dev else default()
+    if path:
+        cfg = load_file(path, base=cfg)
+    if env:
+        apply_env(cfg)
+    if overrides:
+        _merge(cfg, overrides)
+    cfg.validate()
+    return cfg

@@ -1,0 +1,24 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (SURVEY.md §4 multi-node
+story): the env vars must be set before jax is first imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def testdata_dir():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "testdata")

@@ -1,0 +1,110 @@
+"""Config tree tests: defaults, validation, file/env/override loading
+(pkg/config/config.go parity, plus the loading pipeline the reference
+lacked — SURVEY.md §5.6)."""
+
+import json
+
+import pytest
+
+from ggrmcp_tpu.core import config as cfgmod
+
+
+def test_defaults_mirror_reference():
+    cfg = cfgmod.default()
+    assert cfg.server.port == 50053
+    assert cfg.grpc.max_message_bytes == 4 << 20
+    assert cfg.grpc.keepalive.time_s == 10.0
+    assert cfg.grpc.keepalive.timeout_s == 5.0
+    assert cfg.grpc.reconnect.max_attempts == 5
+    assert cfg.grpc.reconnect.interval_s == 5.0
+    assert cfg.mcp.protocol_version == "2024-11-05"
+    assert cfg.session.ttl_s == 1800.0
+    assert cfg.session.max_sessions == 10_000
+    assert cfg.tools.max_schema_depth == 10
+    assert cfg.server.max_request_bytes == 1 << 20
+    assert cfg.server.rate_limit.requests_per_second == 100.0
+    assert cfg.server.rate_limit.burst == 200
+
+
+def test_development_overrides():
+    cfg = cfgmod.development()
+    assert cfg.logging.level == "debug"
+    assert not cfg.server.rate_limit.enabled
+
+
+def test_validate_rejects_bad_port():
+    cfg = cfgmod.default()
+    cfg.server.port = 0
+    with pytest.raises(ValueError):
+        cfg.validate()
+
+
+def test_validate_descriptor_needs_path():
+    cfg = cfgmod.default()
+    cfg.grpc.descriptor_set.enabled = True
+    with pytest.raises(ValueError):
+        cfg.validate()
+
+
+def test_load_json_file(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"server": {"port": 8080}, "grpc": {"host": "tpu-vm"}}))
+    cfg = cfgmod.load_file(str(p))
+    assert cfg.server.port == 8080
+    assert cfg.grpc.host == "tpu-vm"
+    assert cfg.grpc.port == 50051  # untouched default
+
+
+def test_load_yaml_file(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("serving:\n  model: llama3-8b\n  mesh:\n    tensor: 8\n")
+    cfg = cfgmod.load_file(str(p))
+    assert cfg.serving.model == "llama3-8b"
+    assert cfg.serving.mesh.tensor == 8
+
+
+def test_load_file_rejects_unknown_key(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"server": {"prot": 1}}))
+    with pytest.raises(ValueError, match="unknown config key"):
+        cfgmod.load_file(str(p))
+
+
+def test_env_overrides():
+    cfg = cfgmod.default()
+    cfgmod.apply_env(
+        cfg,
+        {
+            "GGRMCP_SERVER_PORT": "9999",
+            "GGRMCP_GRPC_HOST": "remote",
+            "GGRMCP_SERVER_RATE_LIMIT_ENABLED": "false",
+            "GGRMCP_SERVING_MESH_TENSOR": "4",
+            "UNRELATED": "x",
+        },
+    )
+    assert cfg.server.port == 9999
+    assert cfg.grpc.host == "remote"
+    assert not cfg.server.rate_limit.enabled
+    assert cfg.serving.mesh.tensor == 4
+
+
+def test_env_unknown_rejected():
+    with pytest.raises(ValueError):
+        cfgmod.apply_env(cfgmod.default(), {"GGRMCP_NOPE_NOPE": "1"})
+
+
+def test_env_list_coercion():
+    cfg = cfgmod.default()
+    cfgmod.apply_env(
+        cfg, {"GGRMCP_SERVER_ALLOWED_CONTENT_TYPES": "application/json,text/plain"}
+    )
+    assert cfg.server.allowed_content_types == ["application/json", "text/plain"]
+
+
+def test_full_load_pipeline(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"server": {"port": 7000}}))
+    cfg = cfgmod.load(
+        path=str(p), env=False, overrides={"server": {"port": 7001}}
+    )
+    assert cfg.server.port == 7001  # overrides beat file

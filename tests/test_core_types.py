@@ -1,0 +1,51 @@
+"""Tool-name mangling and MethodInfo tests (reference parity:
+pkg/types/service.go edge cases from pkg/grpc/discovery_edge_cases_test.go)."""
+
+from ggrmcp_tpu.core.types import MethodInfo, generate_tool_name, is_valid_tool_name
+
+
+def test_tool_name_basic():
+    assert (
+        generate_tool_name("hello.HelloService", "SayHello")
+        == "hello_helloservice_sayhello"
+    )
+
+
+def test_tool_name_deep_package():
+    assert (
+        generate_tool_name("com.example.hello.HelloService", "SayHello")
+        == "com_example_hello_helloservice_sayhello"
+    )
+
+
+def test_tool_name_no_package():
+    assert generate_tool_name("BareService", "Do") == "bareservice_do"
+
+
+def test_tool_name_mixed_case():
+    assert generate_tool_name("A.B.CService", "DoIt") == "a_b_cservice_doit"
+
+
+def test_tool_name_validity():
+    assert is_valid_tool_name("hello_helloservice_sayhello")
+    assert not is_valid_tool_name("")
+    assert not is_valid_tool_name("nounderscore")
+    assert not is_valid_tool_name("bad name_with space")
+
+
+def test_method_info_paths():
+    mi = MethodInfo(
+        name="SayHello", full_name="hello.HelloService.SayHello",
+        service_name="hello.HelloService",
+    )
+    assert mi.grpc_path == "/hello.HelloService/SayHello"
+    assert mi.tool_name == "hello_helloservice_sayhello"
+    assert not mi.is_streaming
+
+
+def test_method_info_streaming_flags():
+    mi = MethodInfo(
+        name="Watch", full_name="s.S.Watch", service_name="s.S",
+        is_server_streaming=True,
+    )
+    assert mi.is_streaming

@@ -1,0 +1,79 @@
+"""Header forwarding policy tests (pkg/headers/filter_test.go parity:
+precedence blocked > forward_all > allowlist; default-config assertions)."""
+
+from ggrmcp_tpu.core.config import HeaderForwardingConfig
+from ggrmcp_tpu.core.headers import HeaderFilter
+
+
+def make_filter(**kw):
+    return HeaderFilter(HeaderForwardingConfig(**kw))
+
+
+def test_disabled_forwards_nothing():
+    f = make_filter(enabled=False, forward_all=True)
+    assert not f.should_forward("authorization")
+
+
+def test_allowlist_membership():
+    f = make_filter()
+    assert f.should_forward("authorization")
+    assert f.should_forward("x-trace-id")
+    assert not f.should_forward("x-random-header")
+
+
+def test_blocked_always_wins():
+    f = make_filter(forward_all=True)
+    assert not f.should_forward("cookie")
+    assert not f.should_forward("host")
+    assert f.should_forward("x-anything-else")
+
+
+def test_blocked_beats_allowed():
+    f = make_filter(
+        allowed_headers=["cookie"], blocked_headers=["cookie"]
+    )
+    assert not f.should_forward("cookie")
+
+
+def test_case_insensitive_default():
+    f = make_filter()
+    assert f.should_forward("Authorization")
+    assert f.should_forward("AUTHORIZATION")
+    assert not f.should_forward("Cookie")
+
+
+def test_case_sensitive_mode():
+    f = make_filter(case_insensitive=False, allowed_headers=["X-Exact"])
+    assert f.should_forward("X-Exact")
+    assert not f.should_forward("x-exact")
+
+
+def test_filter_headers_map():
+    f = make_filter()
+    out = f.filter_headers(
+        {"Authorization": "Bearer t", "Cookie": "no", "X-Trace-Id": "1"}
+    )
+    assert set(out) == {"Authorization", "X-Trace-Id"}
+
+
+def test_multivalue_preserved_in_metadata():
+    # Fixed vs reference: all values forwarded, not just the first
+    # (pkg/server/handler.go:320-328 kept only headers[0]).
+    f = make_filter()
+    md = f.to_grpc_metadata({"Accept-Language": ["en", "de"]})
+    assert md == [("accept-language", "en"), ("accept-language", "de")]
+
+
+def test_session_id_never_forwarded_by_default():
+    f = make_filter()
+    assert not f.should_forward("Mcp-Session-Id")
+
+
+def test_default_config_policy_suite():
+    # Assertion suite over the defaults (filter_test.go:226-247 parity).
+    f = make_filter()
+    for h in ["authorization", "x-trace-id", "x-request-id", "x-api-key"]:
+        assert f.should_forward(h), h
+    for h in ["cookie", "set-cookie", "host", "content-length", "te",
+              "transfer-encoding", "proxy-authorization"]:
+        assert not f.should_forward(h), h

@@ -1,0 +1,131 @@
+"""Validator and sanitizer tests (pkg/mcp/validation.go parity matrix)."""
+
+import pytest
+
+from ggrmcp_tpu.core.config import ValidationConfig
+from ggrmcp_tpu.mcp.types import INVALID_PARAMS, INVALID_REQUEST, MCPError
+from ggrmcp_tpu.mcp.validation import Validator, sanitize_error, sanitize_string
+
+
+@pytest.fixture
+def validator():
+    return Validator()
+
+
+def _req(**kw):
+    base = {"jsonrpc": "2.0", "method": "tools/list", "id": 1}
+    base.update(kw)
+    return base
+
+
+class TestValidateRequest:
+    def test_valid(self, validator):
+        validator.validate_request(_req())
+
+    def test_valid_string_id(self, validator):
+        validator.validate_request(_req(id="abc-123"))
+
+    def test_wrong_version(self, validator):
+        with pytest.raises(MCPError) as exc:
+            validator.validate_request(_req(jsonrpc="1.0"))
+        assert exc.value.code == INVALID_REQUEST
+
+    def test_missing_method(self, validator):
+        req = _req()
+        del req["method"]
+        with pytest.raises(MCPError):
+            validator.validate_request(req)
+
+    def test_method_bad_chars(self, validator):
+        with pytest.raises(MCPError):
+            validator.validate_request(_req(method="tools list!"))
+
+    def test_method_too_long(self, validator):
+        with pytest.raises(MCPError):
+            validator.validate_request(_req(method="x" * 2000))
+
+    def test_missing_id(self, validator):
+        req = _req()
+        del req["id"]
+        with pytest.raises(MCPError):
+            validator.validate_request(req)
+
+    def test_null_id(self, validator):
+        with pytest.raises(MCPError):
+            validator.validate_request(_req(id=None))
+
+    def test_bool_id_rejected(self, validator):
+        # bool is an int subclass in Python; it is still a valid JSON-RPC
+        # id by our charter (string-or-number) — accept it as numeric.
+        validator.validate_request(_req(id=True))
+
+    def test_non_object(self, validator):
+        with pytest.raises(MCPError):
+            validator.validate_request([1, 2, 3])
+
+
+class TestToolCallParams:
+    def test_valid(self, validator):
+        name, args = validator.validate_tool_call_params(
+            {"name": "hello_helloservice_sayhello", "arguments": {"name": "TPU"}}
+        )
+        assert name == "hello_helloservice_sayhello"
+        assert args == {"name": "TPU"}
+
+    def test_missing_arguments_defaults_empty(self, validator):
+        name, args = validator.validate_tool_call_params({"name": "a_b"})
+        assert args == {}
+
+    def test_bad_name_chars(self, validator):
+        with pytest.raises(MCPError) as exc:
+            validator.validate_tool_call_params({"name": "bad name!"})
+        assert exc.value.code == INVALID_PARAMS
+
+    def test_name_too_long(self, validator):
+        with pytest.raises(MCPError):
+            validator.validate_tool_call_params({"name": "x_" * 200})
+
+    def test_non_dict_args(self, validator):
+        with pytest.raises(MCPError):
+            validator.validate_tool_call_params({"name": "a_b", "arguments": [1]})
+
+
+class TestStructuralLimits:
+    def test_depth_limit(self, validator):
+        deep = {"a": 1}
+        for _ in range(15):
+            deep = {"nest": deep}
+        with pytest.raises(MCPError):
+            validator.validate_value(deep)
+
+    def test_depth_ok(self, validator):
+        shallow = {"a": {"b": {"c": [1, 2, {"d": "e"}]}}}
+        validator.validate_value(shallow)
+
+    def test_size_limit(self):
+        v = Validator(ValidationConfig(max_request_bytes=100))
+        with pytest.raises(MCPError):
+            v.validate_value({"blob": "x" * 200})
+
+
+class TestSanitization:
+    def test_control_chars_stripped(self):
+        assert sanitize_string("a\x00b\x1fc") == "abc"
+
+    def test_newlines_tabs_kept(self):
+        assert sanitize_string("a\nb\tc") == "a\nb\tc"
+
+    def test_length_cap(self):
+        assert len(sanitize_string("x" * 5000)) == 1024
+
+    def test_secret_redaction(self):
+        out = sanitize_error("connect failed: password=hunter2 for user")
+        assert "hunter2" not in out
+        assert "[REDACTED]" in out
+
+    def test_token_redaction(self):
+        out = sanitize_error("invalid token abc123xyz")
+        assert "abc123xyz" not in out
+
+    def test_plain_error_untouched(self):
+        assert sanitize_error("connection refused") == "connection refused"

@@ -1,0 +1,199 @@
+"""Schema engine tests against real generated descriptors — the
+reference's hard-case matrix (pkg/tools/builder_test.go:16-328 parity):
+recursion→$ref, oneof→oneOf, maps→patternProperties, enums, well-known
+types, presence-based required, depth limits, caching, tool building."""
+
+from ggrmcp_tpu.core.config import SchemaCacheConfig, ToolsConfig
+from ggrmcp_tpu.core.types import MethodInfo
+from ggrmcp_tpu.rpc.pb import complex_pb2, hello_pb2, serving_pb2
+from ggrmcp_tpu.schema.builder import SchemaBuilder, ToolBuilder
+
+
+def build(desc, **cfg_kw):
+    return SchemaBuilder(ToolsConfig(**cfg_kw)).message_schema(desc)
+
+
+class TestBasics:
+    def test_simple_message(self):
+        schema = build(hello_pb2.HelloRequest.DESCRIPTOR)
+        assert schema["type"] == "object"
+        assert schema["properties"]["name"] == {"type": "string"}
+        assert "name" in schema["required"]
+
+    def test_scalar_kinds(self):
+        schema = build(complex_pb2.TreeResponse.DESCRIPTOR)
+        props = schema["properties"]
+        assert props["nodeCount"] == {"type": "integer", "format": "int32"}
+        assert props["totalWeight"] == {"type": "integer", "format": "int64"}
+
+    def test_repeated_scalar(self):
+        schema = build(complex_pb2.Profile.DESCRIPTOR)
+        assert schema["properties"]["scores"] == {
+            "type": "array",
+            "items": {"type": "number"},
+        }
+
+
+class TestHardCases:
+    def test_enum_as_string_with_values(self):
+        schema = build(complex_pb2.Profile.DESCRIPTOR)
+        tier = schema["properties"]["tier"]
+        assert tier["type"] == "string"
+        assert "ACCOUNT_TIER_PRO" in tier["enum"]
+
+    def test_timestamp_well_known(self):
+        schema = build(complex_pb2.Profile.DESCRIPTOR)
+        assert schema["properties"]["createdAt"] == {
+            "type": "string",
+            "format": "date-time",
+        }
+
+    def test_map_pattern_properties(self):
+        schema = build(complex_pb2.Profile.DESCRIPTOR)
+        labels = schema["properties"]["labels"]
+        assert labels["type"] == "object"
+        assert labels["patternProperties"][".*"] == {"type": "string"}
+        assert labels["additionalProperties"] is False
+
+    def test_oneof_options(self):
+        schema = build(complex_pb2.Profile.DESCRIPTOR)
+        assert "oneOf" in schema
+        option_keys = set()
+        for opt in schema["oneOf"]:
+            assert opt["type"] == "object"
+            option_keys |= set(opt["properties"].keys())
+        assert option_keys == {"email", "phone", "postal"}
+        # oneof members are not duplicated as plain properties
+        assert "email" not in schema["properties"]
+
+    def test_proto3_optional_not_required_not_oneof(self):
+        schema = build(complex_pb2.Profile.DESCRIPTOR)
+        assert "nickname" in schema["properties"]
+        assert "nickname" not in schema.get("required", [])
+        for opt in schema.get("oneOf", []):
+            assert "nickname" not in opt["properties"]
+
+    def test_recursion_emits_ref_and_definitions(self):
+        schema = build(complex_pb2.TreeNode.DESCRIPTOR)
+        children = schema["properties"]["children"]
+        assert children["items"] == {"$ref": "#/definitions/complexdemo.TreeNode"}
+        defs = schema["definitions"]
+        assert "complexdemo.TreeNode" in defs
+        inner = defs["complexdemo.TreeNode"]
+        assert inner["properties"]["children"]["items"] == {
+            "$ref": "#/definitions/complexdemo.TreeNode"
+        }
+
+    def test_nested_message(self):
+        schema = build(complex_pb2.UpsertProfileRequest.DESCRIPTOR)
+        profile = schema["properties"]["profile"]
+        assert profile["type"] == "object"
+        assert "userId" in profile["properties"]
+        # message fields have presence → not required
+        assert "profile" not in schema.get("required", [])
+
+    def test_depth_limit(self):
+        schema = build(complex_pb2.UpsertProfileRequest.DESCRIPTOR, max_schema_depth=1)
+        profile = schema["properties"]["profile"]
+        assert "depth limit" in profile.get("description", "")
+
+
+class TestTensorExtensions:
+    def test_tensor_message_annotated(self):
+        schema = build(serving_pb2.Tensor.DESCRIPTOR)
+        assert schema.get("x-tensor") is True
+        assert schema["properties"]["dtype"] == {"type": "string"}
+        assert schema["properties"]["shape"] == {
+            "type": "array",
+            "items": {"type": "integer", "format": "int64"},
+        }
+
+    def test_bytes_field(self):
+        schema = build(serving_pb2.Tensor.DESCRIPTOR)
+        assert schema["properties"]["data"] == {"type": "string", "format": "byte"}
+
+
+class TestCache:
+    def test_cache_hit_returns_same_object(self):
+        sb = SchemaBuilder(ToolsConfig())
+        s1 = sb.message_schema(complex_pb2.Profile.DESCRIPTOR)
+        s2 = sb.message_schema(complex_pb2.Profile.DESCRIPTOR)
+        assert s1 is s2
+
+    def test_cache_disabled(self):
+        sb = SchemaBuilder(ToolsConfig(cache=SchemaCacheConfig(enabled=False)))
+        s1 = sb.message_schema(complex_pb2.Profile.DESCRIPTOR)
+        s2 = sb.message_schema(complex_pb2.Profile.DESCRIPTOR)
+        assert s1 is not s2
+        assert s1 == s2
+
+    def test_invalidate(self):
+        sb = SchemaBuilder(ToolsConfig())
+        s1 = sb.message_schema(complex_pb2.Profile.DESCRIPTOR)
+        sb.invalidate_cache()
+        assert sb.message_schema(complex_pb2.Profile.DESCRIPTOR) is not s1
+
+
+class TestToolBuilder:
+    def _mi(self, svc, m, in_d, out_d, **kw):
+        return MethodInfo(
+            name=m, full_name=f"{svc}.{m}", service_name=svc,
+            input_descriptor=in_d, output_descriptor=out_d, **kw,
+        )
+
+    def test_build_tool(self):
+        tb = ToolBuilder()
+        mi = self._mi(
+            "hello.HelloService", "SayHello",
+            hello_pb2.HelloRequest.DESCRIPTOR, hello_pb2.HelloResponse.DESCRIPTOR,
+        )
+        tool = tb.build_tool(mi)
+        assert tool.name == "hello_helloservice_sayhello"
+        assert "SayHello" in tool.description
+        assert tool.input_schema["properties"]["name"] == {"type": "string"}
+        assert tool.output_schema["properties"]["message"] == {"type": "string"}
+
+    def test_description_fallback(self):
+        tb = ToolBuilder()
+        mi = self._mi(
+            "complexdemo.TreeService", "Analyze",
+            complex_pb2.TreeRequest.DESCRIPTOR, complex_pb2.TreeResponse.DESCRIPTOR,
+        )
+        assert (
+            tb.build_tool(mi).description
+            == "Calls the Analyze method of the complexdemo.TreeService service"
+        )
+
+    def test_explicit_description_wins(self):
+        tb = ToolBuilder()
+        mi = self._mi(
+            "hello.HelloService", "SayHello",
+            hello_pb2.HelloRequest.DESCRIPTOR, hello_pb2.HelloResponse.DESCRIPTOR,
+            description="Greets people.",
+        )
+        assert tb.build_tool(mi).description == "Greets people."
+
+    def test_streaming_skipped(self):
+        tb = ToolBuilder()
+        unary = self._mi(
+            "hello.HelloService", "SayHello",
+            hello_pb2.HelloRequest.DESCRIPTOR, hello_pb2.HelloResponse.DESCRIPTOR,
+        )
+        streaming = self._mi(
+            "complexdemo.StreamService", "Watch",
+            complex_pb2.GetProfileRequest.DESCRIPTOR,
+            complex_pb2.ProfileResponse.DESCRIPTOR,
+            is_server_streaming=True,
+        )
+        tools = tb.build_tools([unary, streaming])
+        assert [t.name for t in tools] == ["hello_helloservice_sayhello"]
+
+    def test_broken_method_skipped(self):
+        tb = ToolBuilder()
+        ok = self._mi(
+            "hello.HelloService", "SayHello",
+            hello_pb2.HelloRequest.DESCRIPTOR, hello_pb2.HelloResponse.DESCRIPTOR,
+        )
+        broken = self._mi("x.Y", "Z", None, None)
+        tools = tb.build_tools([broken, ok])
+        assert len(tools) == 1

@@ -1,0 +1,101 @@
+"""Session manager tests (pkg/session/manager.go parity + enforcement fixes)."""
+
+import re
+import threading
+
+from ggrmcp_tpu.core.config import SessionConfig, SessionRateLimitConfig
+from ggrmcp_tpu.core.sessions import SessionManager, new_session_id
+
+
+def test_session_id_format():
+    sid = new_session_id()
+    assert re.fullmatch(r"[0-9a-f]{32}", sid)
+    assert new_session_id() != sid
+
+
+def test_get_or_create_roundtrip():
+    mgr = SessionManager()
+    s1 = mgr.get_or_create("", {"authorization": "tok"})
+    assert s1.headers["authorization"] == "tok"
+    s2 = mgr.get_or_create(s1.id, {})
+    assert s2.id == s1.id
+
+
+def test_unknown_id_creates_fresh():
+    mgr = SessionManager()
+    s = mgr.get_or_create("deadbeef" * 4, {})
+    assert s.id != "deadbeef" * 4
+
+
+def test_headers_update_on_revisit():
+    mgr = SessionManager()
+    s1 = mgr.get_or_create("", {"a": "1"})
+    mgr.get_or_create(s1.id, {"b": "2"})
+    assert s1.headers == {"a": "1", "b": "2"}
+
+
+def test_expiry():
+    mgr = SessionManager(SessionConfig(ttl_s=0.0))
+    s1 = mgr.create({})
+    assert mgr.get(s1.id) is None
+
+
+def test_capacity_eviction_never_fails():
+    mgr = SessionManager(SessionConfig(max_sessions=10))
+    ids = [mgr.create({}).id for _ in range(25)]
+    assert mgr.count() <= 10
+    assert mgr.get(ids[-1]) is not None  # newest survives
+
+
+def test_rate_limit_window():
+    cfg = SessionConfig(
+        rate_limit=SessionRateLimitConfig(enabled=True, requests_per_minute=3)
+    )
+    mgr = SessionManager(cfg)
+    s = mgr.create({})
+    assert all(mgr.check_rate_limit(s) for _ in range(3))
+    assert not mgr.check_rate_limit(s)
+
+
+def test_rate_limit_disabled():
+    cfg = SessionConfig(
+        rate_limit=SessionRateLimitConfig(enabled=False, requests_per_minute=1)
+    )
+    mgr = SessionManager(cfg)
+    s = mgr.create({})
+    assert all(mgr.check_rate_limit(s) for _ in range(10))
+
+
+def test_block_unblock():
+    mgr = SessionManager()
+    s = mgr.create({})
+    assert mgr.block(s.id)
+    assert mgr.get(s.id).blocked
+    assert mgr.unblock(s.id)
+    assert not mgr.get(s.id).blocked
+    assert not mgr.block("nonexistent")
+
+
+def test_call_counting_threadsafe():
+    mgr = SessionManager()
+    s = mgr.create({})
+
+    def bump():
+        for _ in range(500):
+            s.increment_calls()
+
+    threads = [threading.Thread(target=bump) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert s.call_count == 4000
+
+
+def test_stats():
+    mgr = SessionManager()
+    s = mgr.create({})
+    s.increment_calls()
+    stats = mgr.stats()
+    assert stats["sessionCount"] == 1
+    assert stats["totalCalls"] == 1
