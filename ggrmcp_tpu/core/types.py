@@ -54,8 +54,14 @@ class MethodInfo:
 
     @property
     def grpc_path(self) -> str:
-        """Wire path for invocation: /package.Service/Method."""
-        return f"/{self.service_name}/{self.name}"
+        """Wire path for invocation: /package.Service/Method.
+
+        When the service name was compatibility-trimmed (FDS loading,
+        see rpc/descriptors.py), the wire path still uses the original
+        fully-qualified name — the trim is for tool naming only.
+        """
+        svc = self.options.get("untrimmed_service_name", self.service_name)
+        return f"/{svc}/{self.name}"
 
     @property
     def is_streaming(self) -> bool:

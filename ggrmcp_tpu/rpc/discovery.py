@@ -1,0 +1,343 @@
+"""Service discovery orchestration and the tool registry/router.
+
+Capability parity with the reference discoverer (pkg/grpc/discovery.go):
+owns connection + reflection + descriptor-set loading, holds the
+toolName → MethodInfo registry as an immutable dict swapped atomically
+on rediscovery (the Python analogue of the reference's atomic.Pointer,
+discovery.go:21), routes tool invocations, reports stats and health.
+
+Extended beyond the reference: multiple backends — each backend is an
+`Endpoint` (one gRPC target, e.g. one TPU serving sidecar); tools from
+all backends merge into one registry, and invocation routes to the
+owning backend. Streaming methods are registered when the gateway's
+streaming path is enabled instead of being rejected outright.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, AsyncIterator, Optional
+
+from ggrmcp_tpu.core.config import GRPCConfig
+from ggrmcp_tpu.core.types import MethodInfo
+from ggrmcp_tpu.rpc.connection import ChannelManager
+from ggrmcp_tpu.rpc.descriptors import CommentIndex, DescriptorSetLoader
+from ggrmcp_tpu.rpc.reflection_client import DynamicInvoker, ReflectionClient
+
+logger = logging.getLogger("ggrmcp.rpc.discovery")
+
+
+class ToolNotFoundError(KeyError):
+    pass
+
+
+class StreamingNotSupportedError(RuntimeError):
+    pass
+
+
+class Backend:
+    """One upstream gRPC target: channel + reflection + invoker."""
+
+    def __init__(self, name: str, target: str, cfg: GRPCConfig):
+        self.name = name
+        self.target = target
+        self.cfg = cfg
+        self.manager = ChannelManager(target, cfg)
+        self.reflection: Optional[ReflectionClient] = None
+        self.invoker: Optional[DynamicInvoker] = None
+        self.methods: list[MethodInfo] = []
+        self.comments = CommentIndex()
+        self.healthy = False
+        self.last_discovery: float = 0.0
+
+    async def connect(self, timeout_s: Optional[float] = None) -> None:
+        """Dial + build reflection client + deep health check
+        (discovery.go:65-88 parity)."""
+        channel = await self.manager.connect(timeout_s)
+        self.reflection = ReflectionClient(channel)
+        self.invoker = DynamicInvoker(channel)
+        self.healthy = await self.reflection.health_check()
+        if not self.healthy:
+            raise ConnectionError(
+                f"backend {self.target}: reflection health check failed"
+            )
+
+    async def discover(self) -> list[MethodInfo]:
+        """Reflection discovery; descriptor-set discovery happens at the
+        discoverer level since it needs no connection."""
+        if self.reflection is None:
+            raise ConnectionError(f"backend {self.target} not connected")
+        methods, comments = await self.reflection.discover_methods()
+        self.methods = methods
+        self.comments = comments
+        self.last_discovery = time.time()
+        return methods
+
+    async def health_check(self) -> bool:
+        if self.reflection is None:
+            return False
+        conn_ok = await self.manager.health_check()
+        if not conn_ok:
+            self.healthy = False
+            return False
+        self.healthy = await self.reflection.health_check()
+        return self.healthy
+
+    async def close(self) -> None:
+        await self.manager.close()
+
+
+class ServiceDiscoverer:
+    """Discovers tools across backends and routes invocations."""
+
+    def __init__(
+        self,
+        targets: list[str] | str,
+        cfg: Optional[GRPCConfig] = None,
+        allow_streaming_tools: bool = True,
+    ):
+        self.cfg = cfg or GRPCConfig()
+        if isinstance(targets, str):
+            targets = [targets]
+        self.backends = [
+            Backend(f"backend{i}", target, self.cfg)
+            for i, target in enumerate(targets)
+        ]
+        self.allow_streaming_tools = allow_streaming_tools
+        # tool name → (MethodInfo, Backend). Immutable dict, swapped
+        # whole on rediscovery — lock-free reads under the GIL, the
+        # Python analogue of atomic.Pointer (discovery.go:21,122-127).
+        self._tools: dict[str, tuple[MethodInfo, Optional[Backend]]] = {}
+        self._watchdog_task: Optional[asyncio.Task] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def connect(self, timeout_s: Optional[float] = None) -> int:
+        """Connect all backends; tolerate partial failure, raise if none."""
+        results = await asyncio.gather(
+            *(b.connect(timeout_s) for b in self.backends), return_exceptions=True
+        )
+        up = sum(1 for r in results if not isinstance(r, BaseException))
+        for backend, result in zip(self.backends, results):
+            if isinstance(result, BaseException):
+                logger.warning("backend %s connect failed: %s", backend.target, result)
+        if up == 0 and self.backends:
+            raise ConnectionError("no backends reachable")
+        return up
+
+    async def discover_services(self) -> int:
+        """(Re)build the tool registry (discovery.go:91-129). If a
+        descriptor set is configured it is loaded first (richer
+        comments); reflection fills in the rest, keyed per backend."""
+        registry: dict[str, tuple[MethodInfo, Optional[Backend]]] = {}
+
+        fds_methods: dict[str, MethodInfo] = {}
+        if self.cfg.descriptor_set.enabled and self.cfg.descriptor_set.path:
+            try:
+                loader = DescriptorSetLoader(self.cfg.descriptor_set.path).load()
+                for mi in loader.extract_method_info():
+                    fds_methods[mi.tool_name] = mi
+                logger.info(
+                    "descriptor set: %d methods from %s",
+                    len(fds_methods), self.cfg.descriptor_set.path,
+                )
+            except Exception as exc:
+                logger.warning(
+                    "descriptor set load failed (%s); falling back to reflection",
+                    exc,
+                )
+
+        for backend in self.backends:
+            if backend.reflection is None:
+                continue
+            try:
+                methods = await backend.discover()
+            except Exception as exc:
+                logger.warning("discovery failed for %s: %s", backend.target, exc)
+                continue
+            for mi in methods:
+                if mi.is_streaming and not self.allow_streaming_tools:
+                    continue
+                fds_mi = fds_methods.get(mi.tool_name)
+                if fds_mi is not None and self.cfg.descriptor_set.prefer_over_reflection:
+                    # FDS wins for metadata (comments) but keeps the live
+                    # backend's descriptors for invocation compatibility.
+                    mi.description = mi.description or fds_mi.description
+                    mi.service_description = (
+                        mi.service_description or fds_mi.service_description
+                    )
+                registry[mi.tool_name] = (mi, backend)
+
+        # Descriptor-set-only methods (no live backend yet) are exposed
+        # for listing and routed to the first backend on call.
+        default_backend = self.backends[0] if self.backends else None
+        for tool_name, mi in fds_methods.items():
+            if tool_name not in registry:
+                registry[tool_name] = (mi, default_backend)
+
+        self._tools = registry  # atomic swap
+        logger.info("tool registry: %d tools", len(registry))
+        return len(registry)
+
+    async def close(self) -> None:
+        await self.stop_watchdog()
+        await asyncio.gather(
+            *(b.close() for b in self.backends), return_exceptions=True
+        )
+
+    # -- background watchdog (fixes the reference's dead Reconnect) --------
+
+    def start_watchdog(self) -> None:
+        if self._watchdog_task is None:
+            self._watchdog_task = asyncio.get_running_loop().create_task(
+                self._watchdog()
+            )
+
+    async def stop_watchdog(self) -> None:
+        if self._watchdog_task is not None:
+            self._watchdog_task.cancel()
+            try:
+                await self._watchdog_task
+            except asyncio.CancelledError:
+                pass
+            self._watchdog_task = None
+
+    async def _watchdog(self) -> None:
+        interval = self.cfg.reconnect.watchdog_interval_s
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                changed = False
+                for backend in self.backends:
+                    was = backend.healthy
+                    ok = await backend.health_check()
+                    if not ok and self.cfg.reconnect.enabled:
+                        ok = await self._try_reconnect(backend)
+                    if ok and not was:
+                        changed = True
+                if changed:
+                    await self.discover_services()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("discovery watchdog pass failed")
+
+    async def _try_reconnect(self, backend: Backend) -> bool:
+        for attempt in range(self.cfg.reconnect.max_attempts):
+            try:
+                await backend.connect()
+                return True
+            except Exception as exc:
+                logger.warning(
+                    "reconnect %s attempt %d/%d failed: %s",
+                    backend.target, attempt + 1,
+                    self.cfg.reconnect.max_attempts, exc,
+                )
+                await asyncio.sleep(self.cfg.reconnect.interval_s)
+        return False
+
+    # -- registry access ----------------------------------------------------
+
+    def get_methods(self) -> list[MethodInfo]:
+        return [mi for mi, _ in self._tools.values()]
+
+    def get_method_by_tool(self, tool_name: str) -> MethodInfo:
+        entry = self._tools.get(tool_name)
+        if entry is None:
+            raise ToolNotFoundError(f"tool not found: {tool_name}")
+        return entry[0]
+
+    def comment_fn(self, desc) -> str:
+        """Merged comment provider across all backends, for the schema
+        builder."""
+        for backend in self.backends:
+            comment = backend.comments.comment_fn(desc)
+            if comment:
+                return comment
+        return ""
+
+    # -- invocation ---------------------------------------------------------
+
+    def _route(self, tool_name: str) -> tuple[MethodInfo, Backend]:
+        entry = self._tools.get(tool_name)
+        if entry is None:
+            raise ToolNotFoundError(f"tool not found: {tool_name}")
+        method, backend = entry
+        if backend is None or backend.invoker is None:
+            raise ConnectionError(f"no live backend for tool {tool_name}")
+        return method, backend
+
+    async def invoke_by_tool(
+        self,
+        tool_name: str,
+        arguments: dict[str, Any],
+        headers: Optional[list[tuple[str, str]]] = None,
+        timeout_s: Optional[float] = None,
+    ) -> dict[str, Any]:
+        """Route a unary tool call (discovery.go:346-375 parity)."""
+        method, backend = self._route(tool_name)
+        if method.is_streaming:
+            raise StreamingNotSupportedError(
+                f"tool {tool_name} is streaming; use invoke_stream_by_tool"
+            )
+        timeout = timeout_s if timeout_s is not None else self.cfg.call_timeout_s
+        return await backend.invoker.invoke(method, arguments, headers, timeout)
+
+    async def invoke_stream_by_tool(
+        self,
+        tool_name: str,
+        arguments: dict[str, Any],
+        headers: Optional[list[tuple[str, str]]] = None,
+        timeout_s: Optional[float] = None,
+    ) -> AsyncIterator[dict[str, Any]]:
+        """Route a server-streaming tool call (no reference analogue)."""
+        method, backend = self._route(tool_name)
+        if method.is_client_streaming:
+            raise StreamingNotSupportedError("client streaming not supported")
+        timeout = timeout_s if timeout_s is not None else self.cfg.call_timeout_s
+        if not method.is_server_streaming:
+            yield await backend.invoker.invoke(method, arguments, headers, timeout)
+            return
+        async for chunk in backend.invoker.invoke_stream(
+            method, arguments, headers, timeout
+        ):
+            yield chunk
+
+    # -- health / stats -----------------------------------------------------
+
+    async def health_check(self) -> bool:
+        """Healthy iff at least one backend passes its deep check."""
+        if not self.backends:
+            return bool(self._tools)
+        results = await asyncio.gather(
+            *(b.health_check() for b in self.backends), return_exceptions=True
+        )
+        return any(r is True for r in results)
+
+    def get_service_stats(self) -> dict[str, Any]:
+        """Structured stats (discovery.go:279-333 parity, per-backend)."""
+        services: dict[str, int] = {}
+        streaming = 0
+        for mi, _ in self._tools.values():
+            services[mi.service_name] = services.get(mi.service_name, 0) + 1
+            streaming += mi.is_streaming
+        return {
+            "serviceCount": len(services),
+            "methodCount": len(self._tools),
+            "streamingMethodCount": streaming,
+            "isConnected": any(b.manager.is_connected() for b in self.backends),
+            "services": [
+                {"name": name, "methodCount": count}
+                for name, count in sorted(services.items())
+            ],
+            "backends": [
+                {
+                    "target": b.target,
+                    "healthy": b.healthy,
+                    "methodCount": len(b.methods),
+                }
+                for b in self.backends
+            ],
+        }

@@ -16,7 +16,25 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
 import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run `async def` tests via asyncio.run — pytest-asyncio is not
+    available in this environment. Async fixtures are not supported;
+    tests use async context managers for setup instead."""
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(func(**kwargs))
+        return True
+    return None
 
 
 @pytest.fixture(scope="session")

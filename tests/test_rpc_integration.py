@@ -1,0 +1,248 @@
+"""End-to-end RPC-layer tests against the in-process backend: reflection
+discovery, JSON→proto→gRPC→proto→JSON invocation for complex types,
+error propagation, streaming, concurrency
+(tests/real_grpc_invocation_test.go parity matrix)."""
+
+import asyncio
+import contextlib
+import os
+
+import pytest
+
+from ggrmcp_tpu.core.config import GRPCConfig
+from ggrmcp_tpu.rpc.discovery import ServiceDiscoverer, ToolNotFoundError
+from tests.backend_utils import MAGIC_ERROR_USER, InProcessBackend
+
+
+@contextlib.asynccontextmanager
+async def rpc_env():
+    """In-process backend + connected, discovered ServiceDiscoverer."""
+    async with InProcessBackend() as backend:
+        d = ServiceDiscoverer(backend.target, GRPCConfig(connect_timeout_s=5.0))
+        await d.connect()
+        await d.discover_services()
+        try:
+            yield backend, d
+        finally:
+            await d.close()
+
+
+class TestDiscovery:
+    async def test_tools_discovered(self):
+        async with rpc_env() as (_, d):
+            tools = {m.tool_name for m in d.get_methods()}
+            assert "hello_helloservice_sayhello" in tools
+            assert "complexdemo_profileservice_getprofile" in tools
+            assert "complexdemo_treeservice_analyze" in tools
+            assert "complexdemo_streamservice_watch" in tools
+
+    async def test_internal_services_filtered(self):
+        async with rpc_env() as (_, d):
+            for m in d.get_methods():
+                assert not m.service_name.startswith("grpc.")
+
+    async def test_descriptors_resolved_cross_file(self):
+        # Profile messages import google/protobuf/timestamp.proto — deps
+        # must survive (the reference dropped them, reflection.go:241).
+        async with rpc_env() as (_, d):
+            mi = d.get_method_by_tool("complexdemo_profileservice_getprofile")
+            profile_field = mi.output_descriptor.fields_by_name["profile"]
+            created = profile_field.message_type.fields_by_name["created_at"]
+            assert created.message_type.full_name == "google.protobuf.Timestamp"
+
+    async def test_streaming_flags(self):
+        async with rpc_env() as (_, d):
+            mi = d.get_method_by_tool("complexdemo_streamservice_watch")
+            assert mi.is_server_streaming
+
+    async def test_stats(self):
+        async with rpc_env() as (_, d):
+            stats = d.get_service_stats()
+            assert stats["serviceCount"] == 4
+            assert stats["methodCount"] == 5
+            assert stats["isConnected"]
+
+    async def test_health(self):
+        async with rpc_env() as (_, d):
+            assert await d.health_check()
+
+
+class TestInvocation:
+    async def test_hello_roundtrip(self):
+        async with rpc_env() as (_, d):
+            result = await d.invoke_by_tool(
+                "hello_helloservice_sayhello", {"name": "TPU"}
+            )
+            assert result == {"message": "Hello, TPU!"}
+
+    async def test_salutation_field(self):
+        async with rpc_env() as (_, d):
+            result = await d.invoke_by_tool(
+                "hello_helloservice_sayhello", {"name": "x", "salutation": "Yo"}
+            )
+            assert result == {"message": "Yo, x!"}
+
+    async def test_complex_types_roundtrip(self):
+        async with rpc_env() as (_, d):
+            result = await d.invoke_by_tool(
+                "complexdemo_profileservice_getprofile", {"userId": "alice"}
+            )
+            profile = result["profile"]
+            assert profile["userId"] == "alice"
+            assert profile["tier"] == "ACCOUNT_TIER_PRO"
+            assert profile["email"] == "alice@example.com"
+            assert profile["labels"] == {"env": "test"}
+            assert profile["createdAt"].startswith("2023-11-")
+
+    async def test_oneof_and_map_input(self):
+        async with rpc_env() as (_, d):
+            args = {
+                "profile": {
+                    "userId": "bob",
+                    "displayName": "Bob",
+                    "tier": "ACCOUNT_TIER_FREE",
+                    "labels": {"a": "1", "b": "2"},
+                    "phone": "+1-555",
+                    "scores": [1.5, 2.5],
+                }
+            }
+            result = await d.invoke_by_tool(
+                "complexdemo_profileservice_upsertprofile", args
+            )
+            out = result["profile"]
+            assert out["phone"] == "+1-555"
+            assert out["labels"] == {"a": "1", "b": "2"}
+            assert out["scores"] == [1.5, 2.5]
+
+    async def test_recursive_tree(self):
+        async with rpc_env() as (_, d):
+            tree = {
+                "root": {
+                    "label": "a",
+                    "weight": "1",
+                    "children": [
+                        {"label": "b", "weight": "2", "children": []},
+                        {
+                            "label": "c",
+                            "weight": "3",
+                            "children": [
+                                {"label": "d", "weight": "4", "children": []}
+                            ],
+                        },
+                    ],
+                }
+            }
+            result = await d.invoke_by_tool(
+                "complexdemo_treeservice_analyze", tree
+            )
+            assert result["nodeCount"] == 4
+            assert result["totalWeight"] == "10"  # int64 → JSON string
+
+    async def test_unicode(self):
+        async with rpc_env() as (_, d):
+            result = await d.invoke_by_tool(
+                "hello_helloservice_sayhello", {"name": "Grüße 世界 🚀"}
+            )
+            assert "Grüße 世界 🚀" in result["message"]
+
+    async def test_unknown_tool(self):
+        async with rpc_env() as (_, d):
+            with pytest.raises(ToolNotFoundError):
+                await d.invoke_by_tool("no_such_tool", {})
+
+    async def test_unknown_field_rejected(self):
+        async with rpc_env() as (_, d):
+            with pytest.raises(Exception) as exc:
+                await d.invoke_by_tool("hello_helloservice_sayhello", {"nope": 1})
+            assert "nope" in str(exc.value)
+
+    async def test_backend_error_propagates(self):
+        import grpc
+
+        async with rpc_env() as (_, d):
+            with pytest.raises(grpc.aio.AioRpcError) as exc:
+                await d.invoke_by_tool(
+                    "complexdemo_profileservice_getprofile",
+                    {"userId": MAGIC_ERROR_USER},
+                )
+            assert "backend exploded" in exc.value.details()
+
+    async def test_headers_forwarded_as_metadata(self):
+        async with rpc_env() as (_, d):
+            result = await d.invoke_by_tool(
+                "hello_helloservice_sayhello",
+                {"name": "hdr"},
+                headers=[("x-trace-id", "t-1"), ("authorization", "Bearer x")],
+            )
+            assert result["message"] == "Hello, hdr!"
+
+    async def test_concurrent_invocations(self):
+        async with rpc_env() as (_, d):
+            async def one(i: int):
+                return await d.invoke_by_tool(
+                    "hello_helloservice_sayhello", {"name": f"u{i}"}
+                )
+
+            results = await asyncio.gather(*(one(i) for i in range(20)))
+            assert [r["message"] for r in results] == [
+                f"Hello, u{i}!" for i in range(20)
+            ]
+
+
+class TestStreaming:
+    async def test_server_streaming(self):
+        async with rpc_env() as (_, d):
+            chunks = []
+            async for chunk in d.invoke_stream_by_tool(
+                "complexdemo_streamservice_watch", {"userId": "w"}
+            ):
+                chunks.append(chunk)
+            assert len(chunks) == 3
+            assert chunks[0]["profile"]["displayName"] == "update-0"
+            assert chunks[2]["profile"]["displayName"] == "update-2"
+
+    async def test_unary_via_stream_api(self):
+        async with rpc_env() as (_, d):
+            chunks = [
+                c
+                async for c in d.invoke_stream_by_tool(
+                    "hello_helloservice_sayhello", {"name": "s"}
+                )
+            ]
+            assert chunks == [{"message": "Hello, s!"}]
+
+
+class TestDescriptorSet:
+    async def test_fds_discovery_without_backend(self, testdata_dir):
+        cfg = GRPCConfig()
+        cfg.descriptor_set.enabled = True
+        cfg.descriptor_set.path = os.path.join(testdata_dir, "complex.binpb")
+        d = ServiceDiscoverer([], cfg)
+        await d.discover_services()
+        tools = {m.tool_name for m in d.get_methods()}
+        assert "complexdemo_profileservice_getprofile" in tools
+        mi = d.get_method_by_tool("complexdemo_profileservice_getprofile")
+        assert "Fetch a profile" in mi.description
+        await d.close()
+
+    async def test_fds_comments_reach_tools(self, testdata_dir):
+        from ggrmcp_tpu.rpc.descriptors import DescriptorSetLoader
+
+        loader = DescriptorSetLoader(
+            os.path.join(testdata_dir, "hello.binpb")
+        ).load()
+        methods = loader.extract_method_info()
+        by_tool = {m.tool_name: m for m in methods}
+        mi = by_tool["hello_helloservice_sayhello"]
+        assert "greeting" in mi.description
+        assert "greets callers" in mi.service_description.lower()
+        assert "person to greet" in loader.comments.get("hello.HelloRequest.name")
+
+    async def test_fds_name_trim(self):
+        from ggrmcp_tpu.rpc.descriptors import trim_service_name
+
+        assert trim_service_name("com.example.hello.HelloService") == (
+            "hello.HelloService"
+        )
+        assert trim_service_name("hello.HelloService") == "hello.HelloService"
+        assert trim_service_name("Bare") == "Bare"
