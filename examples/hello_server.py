@@ -1,0 +1,55 @@
+"""Standalone hello gRPC server — the interop smoke-test backend
+(examples/hello-service capability parity: unary SayHello + reflection
++ health, --port flag).
+
+Run:  python examples/hello_server.py --port 50051
+Then: python -m ggrmcp_tpu gateway --grpc-port 50051 --http-port 50053
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import grpc.aio
+
+from ggrmcp_tpu.rpc.pb import hello_pb2
+from ggrmcp_tpu.rpc.server_utils import (
+    HealthService,
+    MethodDef,
+    ReflectionService,
+    add_service,
+)
+
+
+async def say_hello(request: hello_pb2.HelloRequest, context) -> hello_pb2.HelloResponse:
+    salutation = request.salutation or "Hello"
+    return hello_pb2.HelloResponse(message=f"{salutation}, {request.name}!")
+
+
+async def serve(port: int) -> None:
+    server = grpc.aio.server()
+    add_service(
+        server,
+        "hello.HelloService",
+        {"SayHello": MethodDef(say_hello, hello_pb2.HelloRequest, hello_pb2.HelloResponse)},
+    )
+    ReflectionService(["hello.HelloService"]).attach(server)
+    HealthService().attach(server)
+    bound = server.add_insecure_port(f"0.0.0.0:{port}")
+    await server.start()
+    logging.info("hello-service listening on :%d", bound)
+    await server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=50051)
+    args = parser.parse_args()
+    asyncio.run(serve(args.port))

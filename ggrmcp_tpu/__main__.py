@@ -1,0 +1,108 @@
+"""CLI entry point: `python -m ggrmcp_tpu [gateway|sidecar] ...`.
+
+Capability parity with the reference CLI (cmd/grmcp/main.go:37-42 flags
+--grpc-host/--grpc-port/--http-port/--log-level/--dev/--descriptor),
+extended with config-file/env loading, multi-backend targets, and the
+TPU mode that co-launches a JAX serving sidecar (BASELINE.json north
+star: `cmd/grmcp --tpu`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ggrmcp_tpu.core import config as cfgmod
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="ggrmcp_tpu", description="TPU-native gRPC <-> MCP gateway"
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    gw = sub.add_parser("gateway", help="run the MCP gateway")
+    gw.add_argument("--grpc-host", default=None, help="backend gRPC host")
+    gw.add_argument("--grpc-port", type=int, default=None, help="backend gRPC port")
+    gw.add_argument("--http-port", type=int, default=None, help="HTTP listen port")
+    gw.add_argument("--log-level", default=None, help="debug|info|warning|error")
+    gw.add_argument("--dev", action="store_true", help="development mode")
+    gw.add_argument(
+        "--descriptor", default=None, help="FileDescriptorSet (.binpb) path"
+    )
+    gw.add_argument("--config", default=None, help="YAML/JSON config file")
+    gw.add_argument(
+        "--backend",
+        action="append",
+        default=None,
+        metavar="HOST:PORT",
+        help="backend target; repeat for a pool (overrides --grpc-host/port)",
+    )
+    gw.add_argument(
+        "--tpu",
+        action="store_true",
+        help="co-launch a JAX TPU serving sidecar and register it",
+    )
+    gw.add_argument("--model", default=None, help="sidecar model (with --tpu)")
+
+    sc = sub.add_parser("sidecar", help="run the TPU serving sidecar only")
+    sc.add_argument("--port", type=int, default=None, help="gRPC listen port")
+    sc.add_argument("--model", default=None, help="model registry key")
+    sc.add_argument("--config", default=None, help="YAML/JSON config file")
+    sc.add_argument("--log-level", default=None)
+
+    return parser
+
+
+def load_config(args: argparse.Namespace) -> cfgmod.Config:
+    cfg = cfgmod.load(
+        path=getattr(args, "config", None),
+        env=True,
+        dev=getattr(args, "dev", False),
+    )
+    if getattr(args, "grpc_host", None):
+        cfg.grpc.host = args.grpc_host
+    if getattr(args, "grpc_port", None):
+        cfg.grpc.port = args.grpc_port
+    if getattr(args, "http_port", None):
+        cfg.server.port = args.http_port
+    if getattr(args, "log_level", None):
+        cfg.logging.level = args.log_level
+    if getattr(args, "descriptor", None):
+        cfg.grpc.descriptor_set.enabled = True
+        cfg.grpc.descriptor_set.path = args.descriptor
+    if getattr(args, "model", None):
+        cfg.serving.model = args.model
+    if getattr(args, "port", None):
+        cfg.serving.port = args.port
+    cfg.validate()
+    return cfg
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "sidecar":
+        cfg = load_config(args)
+        from ggrmcp_tpu.serving.sidecar import run as run_sidecar
+
+        run_sidecar(cfg)
+        return 0
+    if args.command == "gateway" or args.command is None:
+        if args.command is None:
+            args = build_parser().parse_args(["gateway"] + (argv or sys.argv[1:]))
+        cfg = load_config(args)
+        targets = args.backend if args.backend else [cfg.grpc.target]
+        if args.tpu:
+            from ggrmcp_tpu.serving.launcher import run_gateway_with_sidecar
+
+            run_gateway_with_sidecar(cfg, targets)
+        else:
+            from ggrmcp_tpu.gateway.app import run
+
+            run(cfg, targets)
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -224,6 +224,10 @@ class ToolsConfig:
     emit_output_schema: bool = True
     include_comments: bool = True
     tensor_extensions: bool = True  # x-tensor dtype/shape hints in schemas
+    # Expose server-streaming methods as tools (the reference rejected
+    # all streaming, pkg/tools/builder.go:129-134; the gateway here
+    # serves them aggregated or over SSE). Client streaming stays out.
+    streaming_tools: bool = True
     cache: SchemaCacheConfig = field(default_factory=SchemaCacheConfig)
 
 

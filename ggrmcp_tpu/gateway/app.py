@@ -1,0 +1,126 @@
+"""Gateway composition root: wires config, discovery, sessions, handler,
+middleware, and the HTTP server (cmd/grmcp/main.go capability parity:
+flags → logger → discoverer → sessions → tools → handler → router →
+middleware → server → graceful shutdown)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+from typing import Optional
+
+from aiohttp import web
+
+from ggrmcp_tpu.core.config import Config
+from ggrmcp_tpu.core.sessions import SessionManager
+from ggrmcp_tpu.gateway.handler import MCPHandler
+from ggrmcp_tpu.gateway.metrics import GatewayMetrics
+from ggrmcp_tpu.gateway.middleware import default_middlewares
+from ggrmcp_tpu.rpc.discovery import ServiceDiscoverer
+
+logger = logging.getLogger("ggrmcp.gateway")
+
+
+def setup_logging(cfg: Config) -> None:
+    level = getattr(logging, cfg.logging.level.upper(), logging.INFO)
+    fmt = (
+        '{"ts":"%(asctime)s","level":"%(levelname)s","logger":"%(name)s","msg":"%(message)s"}'
+        if cfg.logging.json_output
+        else "%(asctime)s %(levelname)-7s %(name)s  %(message)s"
+    )
+    logging.basicConfig(level=level, format=fmt)
+
+
+class Gateway:
+    """Owns the full gateway stack; start()/stop() or use run()."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        targets: Optional[list[str]] = None,
+        discoverer: Optional[ServiceDiscoverer] = None,
+    ):
+        self.cfg = cfg
+        self.metrics = GatewayMetrics()
+        self.sessions = SessionManager(cfg.session)
+        self.discoverer = discoverer or ServiceDiscoverer(
+            targets if targets is not None else [cfg.grpc.target], cfg.grpc
+        )
+        self.handler = MCPHandler(cfg, self.discoverer, self.sessions, self.metrics)
+        self.app = self._build_app()
+        self._runner: Optional[web.AppRunner] = None
+        self._site: Optional[web.TCPSite] = None
+        self.port = cfg.server.port
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(
+            middlewares=default_middlewares(self.cfg.server, self.metrics),
+            client_max_size=self.cfg.server.max_request_bytes,
+        )
+        app.router.add_get("/", self.handler.handle_get)
+        app.router.add_post("/", self.handler.handle_post)
+        app.router.add_route("OPTIONS", "/", self.handler.handle_get)
+        app.router.add_get("/health", self.handler.handle_health)
+        app.router.add_get("/metrics", self.handler.handle_metrics)
+        app.router.add_get("/stats", self.handler.handle_stats)
+        return app
+
+    async def start(self, connect_backends: bool = True) -> None:
+        if connect_backends and self.discoverer.backends:
+            try:
+                await self.discoverer.connect(self.cfg.grpc.connect_timeout_s)
+            except ConnectionError as exc:
+                # Fail-fast startup like the reference (main.go:152-170)
+                # unless reconnection is enabled — then serve degraded and
+                # let the watchdog recover the backends.
+                if not self.cfg.grpc.reconnect.enabled:
+                    raise
+                logger.warning("starting degraded: %s", exc)
+        await self.discoverer.discover_services()
+        self.discoverer.start_watchdog()
+
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        self._site = web.TCPSite(
+            self._runner, self.cfg.server.host, self.cfg.server.port
+        )
+        await self._site.start()
+        for s in self._runner.sites:
+            # resolve the real port when configured with 0
+            sock = s._server.sockets[0] if s._server and s._server.sockets else None
+            if sock is not None:
+                self.port = sock.getsockname()[1]
+        logger.info(
+            "gateway listening on %s:%d (%d tools)",
+            self.cfg.server.host, self.port,
+            self.discoverer.get_service_stats()["methodCount"],
+        )
+
+    async def stop(self) -> None:
+        """Graceful shutdown with drain (main.go:94-112)."""
+        await self.discoverer.stop_watchdog()
+        if self._runner is not None:
+            await asyncio.wait_for(
+                self._runner.cleanup(), timeout=self.cfg.server.shutdown_grace_s
+            )
+        await self.discoverer.close()
+
+    async def run_forever(self) -> None:
+        await self.start()
+        stop_event = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop_event.set)
+            except NotImplementedError:  # pragma: no cover (non-unix)
+                pass
+        await stop_event.wait()
+        logger.info("shutting down")
+        await self.stop()
+
+
+def run(cfg: Config, targets: Optional[list[str]] = None) -> None:
+    setup_logging(cfg)
+    gateway = Gateway(cfg, targets)
+    asyncio.run(gateway.run_forever())

@@ -1,0 +1,393 @@
+"""The MCP protocol handler: JSON-RPC dispatch over HTTP.
+
+Capability parity with the reference handler (pkg/server/handler.go):
+GET / returns the initialize result; POST / decodes + validates JSON-RPC
+and dispatches initialize / tools/list / tools/call / prompts/list /
+resources/list; sessions ride the Mcp-Session-Id header and are echoed
+back; backend failures surface as IsError tool results with sanitized
+messages (handler.go:252-259); JSON-RPC errors are written with HTTP 200
+(handler.go:311); /health 503s when no tools are registered.
+
+Deliberately fixed vs the reference (SURVEY.md 'deliberately fix'):
+error codes travel structurally with MCPError instead of substring
+matching on error text (handler.go:118-125); session rate limits and
+blocks are actually enforced; notifications (id-less requests) are
+accepted per JSON-RPC instead of rejected; streaming tools are served
+(aggregated for plain tools/call, incremental over SSE).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, Optional
+
+import grpc
+from aiohttp import web
+from google.protobuf import json_format
+
+from ggrmcp_tpu.core.config import Config
+from ggrmcp_tpu.core.headers import HeaderFilter
+from ggrmcp_tpu.core.sessions import SessionContext, SessionManager
+from ggrmcp_tpu.gateway.metrics import GatewayMetrics
+from ggrmcp_tpu.mcp import types as mcp
+from ggrmcp_tpu.mcp.validation import Validator, sanitize_error
+from ggrmcp_tpu.rpc.discovery import (
+    ServiceDiscoverer,
+    StreamingNotSupportedError,
+    ToolNotFoundError,
+)
+from ggrmcp_tpu.schema.builder import ToolBuilder
+
+logger = logging.getLogger("ggrmcp.gateway.handler")
+
+SESSION_HEADER = "Mcp-Session-Id"
+
+
+class MCPHandler:
+    def __init__(
+        self,
+        cfg: Config,
+        discoverer: ServiceDiscoverer,
+        sessions: Optional[SessionManager] = None,
+        metrics: Optional[GatewayMetrics] = None,
+    ):
+        self.cfg = cfg
+        self.discoverer = discoverer
+        self.sessions = sessions or SessionManager(cfg.session)
+        self.metrics = metrics or GatewayMetrics()
+        self.validator = Validator(cfg.mcp.validation)
+        self.header_filter = HeaderFilter(cfg.grpc.header_forwarding)
+        self.tool_builder = ToolBuilder(cfg.tools, discoverer.comment_fn)
+
+    # ------------------------------------------------------------------
+    # HTTP entry points
+    # ------------------------------------------------------------------
+
+    async def handle_get(self, request: web.Request) -> web.Response:
+        """GET / → capability discovery (handler.go:61-78)."""
+        session = self._session_for(request)
+        result = mcp.initialize_result(
+            self.cfg.mcp.protocol_version,
+            self.cfg.mcp.server_name,
+            self.cfg.mcp.server_version,
+        )
+        response = web.json_response(mcp.make_response(None, result))
+        response.headers[SESSION_HEADER] = session.id
+        return response
+
+    async def handle_post(self, request: web.Request) -> web.StreamResponse:
+        """POST / → JSON-RPC dispatch (handler.go:81-157)."""
+        try:
+            body = await request.read()
+            data = json.loads(body)
+        except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+            return web.json_response(
+                mcp.make_error_response(
+                    None, mcp.PARSE_ERROR, f"parse error: {exc}"
+                )
+            )
+
+        # JSON-RPC notifications (no id) are accepted and acknowledged
+        # with 202/no-content; MCP clients send notifications/initialized.
+        if isinstance(data, dict) and "id" not in data:
+            method = data.get("method", "")
+            logger.debug("notification: %s", method)
+            return web.Response(status=202)
+
+        request_id = data.get("id") if isinstance(data, dict) else None
+        try:
+            self.validator.validate_request(data)
+        except mcp.MCPError as exc:
+            self.metrics.observe_rpc(
+                data.get("method", "?") if isinstance(data, dict) else "?",
+                "invalid",
+            )
+            return web.json_response(
+                mcp.make_error_response(request_id, exc.code, exc.message, exc.data)
+            )
+
+        session = self._session_for(request)
+        method = data["method"]
+        params = data.get("params")
+
+        # Enforced session policy (the reference defined but never called
+        # these — manager.go:178).
+        if session.blocked:
+            return self._error(
+                request_id, session, mcp.INVALID_REQUEST, "session is blocked"
+            )
+        if not self.sessions.check_rate_limit(session):
+            self.metrics.rate_limit_hit("session")
+            return self._error(
+                request_id, session, mcp.INVALID_REQUEST,
+                "session rate limit exceeded",
+            )
+
+        try:
+            if method == "initialize":
+                result = self._handle_initialize()
+            elif method == "ping":
+                result = {}
+            elif method == "tools/list":
+                result = self._handle_tools_list()
+            elif method == "tools/call":
+                if self._wants_sse(request):
+                    return await self._handle_tools_call_sse(
+                        request, request_id, session, params
+                    )
+                result = await self._handle_tools_call(request, session, params)
+            elif method == "prompts/list":
+                result = {"prompts": []}
+            elif method == "resources/list":
+                result = {"resources": []}
+            else:
+                raise mcp.MCPError(
+                    mcp.METHOD_NOT_FOUND, f"method not found: {method}"
+                )
+            self.metrics.observe_rpc(method, "ok")
+            response = web.json_response(mcp.make_response(request_id, result))
+        except mcp.MCPError as exc:
+            self.metrics.observe_rpc(method, "error")
+            response = web.json_response(
+                mcp.make_error_response(request_id, exc.code, exc.message, exc.data)
+            )
+        except Exception as exc:  # unexpected → internal error, sanitized
+            logger.exception("internal error handling %s", method)
+            self.metrics.observe_rpc(method, "internal_error")
+            response = web.json_response(
+                mcp.make_error_response(
+                    request_id, mcp.INTERNAL_ERROR, sanitize_error(str(exc))
+                )
+            )
+        response.headers[SESSION_HEADER] = session.id
+        return response
+
+    # ------------------------------------------------------------------
+    # Method handlers
+    # ------------------------------------------------------------------
+
+    def _handle_initialize(self) -> dict[str, Any]:
+        return mcp.initialize_result(
+            self.cfg.mcp.protocol_version,
+            self.cfg.mcp.server_name,
+            self.cfg.mcp.server_version,
+        )
+
+    def _handle_tools_list(self) -> dict[str, Any]:
+        methods = self.discoverer.get_methods()
+        tools = self.tool_builder.build_tools(methods)
+        return {"tools": [t.to_dict() for t in tools]}
+
+    async def _handle_tools_call(
+        self,
+        request: web.Request,
+        session: SessionContext,
+        params: Any,
+    ) -> dict[str, Any]:
+        tool_name, arguments = self.validator.validate_tool_call_params(params)
+        headers = self.header_filter.to_grpc_metadata(session.headers)
+        start = time.perf_counter()
+        try:
+            method = self.discoverer.get_method_by_tool(tool_name)
+            timeout = self.cfg.server.request_timeout_s
+            if method.is_server_streaming:
+                # Aggregate the stream for plain tools/call clients.
+                chunks = []
+                async for chunk in self.discoverer.invoke_stream_by_tool(
+                    tool_name, arguments, headers, timeout
+                ):
+                    chunks.append(chunk)
+                content = [
+                    mcp.text_content(json.dumps(c, ensure_ascii=False))
+                    for c in chunks
+                ]
+                result = mcp.tool_call_result(content)
+            else:
+                payload = await self.discoverer.invoke_by_tool(
+                    tool_name, arguments, headers, timeout
+                )
+                result = mcp.tool_call_result(
+                    [mcp.text_content(json.dumps(payload, ensure_ascii=False))]
+                )
+        except ToolNotFoundError:
+            raise mcp.MCPError(
+                mcp.METHOD_NOT_FOUND, f"tool not found: {tool_name}"
+            )
+        except StreamingNotSupportedError as exc:
+            raise mcp.MCPError(mcp.INVALID_PARAMS, str(exc))
+        except (json.JSONDecodeError, ValueError, json_format.ParseError) as exc:
+            # Argument→proto transcoding failure = caller error.
+            raise mcp.MCPError(
+                mcp.INVALID_PARAMS, sanitize_error(f"invalid arguments: {exc}")
+            )
+        except grpc.aio.AioRpcError as exc:
+            # Backend failure → IsError result, NOT a protocol error
+            # (handler.go:252-259 behavior, carried over).
+            self.metrics.observe_tool_call(
+                tool_name, "backend_error", time.perf_counter() - start
+            )
+            message = sanitize_error(
+                f"gRPC call failed ({exc.code().name}): {exc.details()}"
+            )
+            session.increment_calls()
+            return mcp.tool_call_error(message)
+        except (ConnectionError, asyncio.TimeoutError) as exc:
+            self.metrics.observe_tool_call(
+                tool_name, "unavailable", time.perf_counter() - start
+            )
+            session.increment_calls()
+            return mcp.tool_call_error(sanitize_error(str(exc)))
+
+        session.increment_calls()
+        self.metrics.observe_tool_call(
+            tool_name, "ok", time.perf_counter() - start
+        )
+        return result
+
+    # ------------------------------------------------------------------
+    # Streaming over SSE (no reference analogue — new capability)
+    # ------------------------------------------------------------------
+
+    def _wants_sse(self, request: web.Request) -> bool:
+        accept = request.headers.get("Accept", "")
+        return "text/event-stream" in accept
+
+    async def _handle_tools_call_sse(
+        self,
+        request: web.Request,
+        request_id: Any,
+        session: SessionContext,
+        params: Any,
+    ) -> web.StreamResponse:
+        """Stream tool output incrementally as SSE events; the final
+        event carries the complete JSON-RPC response."""
+        tool_name, arguments = self.validator.validate_tool_call_params(params)
+        headers = self.header_filter.to_grpc_metadata(session.headers)
+        response = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                SESSION_HEADER: session.id,
+            },
+        )
+        await response.prepare(request)
+        start = time.perf_counter()
+        chunks: list[dict[str, Any]] = []
+        outcome = "ok"
+        try:
+            async for chunk in self.discoverer.invoke_stream_by_tool(
+                tool_name, arguments, headers, self.cfg.server.request_timeout_s
+            ):
+                chunks.append(chunk)
+                await self._sse_event(
+                    response,
+                    "chunk",
+                    {"content": mcp.text_content(json.dumps(chunk, ensure_ascii=False))},
+                )
+            content = [
+                mcp.text_content(json.dumps(c, ensure_ascii=False)) for c in chunks
+            ]
+            final = mcp.make_response(request_id, mcp.tool_call_result(content))
+        except ToolNotFoundError:
+            outcome = "not_found"
+            final = mcp.make_error_response(
+                request_id, mcp.METHOD_NOT_FOUND, f"tool not found: {tool_name}"
+            )
+        except grpc.aio.AioRpcError as exc:
+            outcome = "backend_error"
+            final = mcp.make_response(
+                request_id,
+                mcp.tool_call_error(
+                    sanitize_error(
+                        f"gRPC call failed ({exc.code().name}): {exc.details()}"
+                    )
+                ),
+            )
+        except Exception as exc:
+            outcome = "internal_error"
+            final = mcp.make_error_response(
+                request_id, mcp.INTERNAL_ERROR, sanitize_error(str(exc))
+            )
+        session.increment_calls()
+        self.metrics.observe_tool_call(
+            tool_name, outcome, time.perf_counter() - start
+        )
+        await self._sse_event(response, "result", final)
+        await response.write_eof()
+        return response
+
+    @staticmethod
+    async def _sse_event(response: web.StreamResponse, event: str, data: Any):
+        payload = json.dumps(data, ensure_ascii=False)
+        await response.write(f"event: {event}\ndata: {payload}\n\n".encode())
+
+    # ------------------------------------------------------------------
+    # Health / metrics / stats endpoints
+    # ------------------------------------------------------------------
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        """GET /health (handler.go:331-364): deep backend check + tool
+        count; 503 when degraded."""
+        try:
+            healthy = await asyncio.wait_for(
+                self.discoverer.health_check(), timeout=5.0
+            )
+        except asyncio.TimeoutError:
+            healthy = False
+        stats = self.discoverer.get_service_stats()
+        body = {
+            "status": "healthy" if healthy and stats["methodCount"] > 0 else "unhealthy",
+            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "serviceCount": stats["serviceCount"],
+            "methodCount": stats["methodCount"],
+            "sessions": self.sessions.count(),
+        }
+        status = 200 if body["status"] == "healthy" else 503
+        return web.json_response(body, status=status)
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        """GET /metrics: Prometheus text exposition (replacing the
+        reference's JSON stub)."""
+        stats = self.discoverer.get_service_stats()
+        healthy_backends = sum(1 for b in stats["backends"] if b["healthy"])
+        self.metrics.set_gauges(self.sessions.count(), healthy_backends)
+        payload, content_type = self.metrics.render()
+        return web.Response(body=payload, content_type=content_type.split(";")[0])
+
+    async def handle_stats(self, request: web.Request) -> web.Response:
+        """GET /stats: the reference's JSON stats dump, kept for parity
+        (handler.go:367-376)."""
+        stats = self.discoverer.get_service_stats()
+        stats["sessions"] = self.sessions.stats()
+        return web.json_response(stats)
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    def _session_for(self, request: web.Request) -> SessionContext:
+        """Resolve/mint the session from Mcp-Session-Id; ALL header
+        values are captured (multi-value fix)."""
+        raw_headers: dict[str, Any] = {}
+        for key in set(request.headers.keys()):
+            values = request.headers.getall(key)
+            raw_headers[key] = values[0] if len(values) == 1 else list(values)
+        sid = request.headers.get(SESSION_HEADER, "")
+        return self.sessions.get_or_create(sid, raw_headers)
+
+    def _error(
+        self,
+        request_id: Any,
+        session: SessionContext,
+        code: int,
+        message: str,
+    ) -> web.Response:
+        response = web.json_response(
+            mcp.make_error_response(request_id, code, message)
+        )
+        response.headers[SESSION_HEADER] = session.id
+        return response

@@ -1,0 +1,139 @@
+"""Gateway metrics: real Prometheus counters/histograms.
+
+The reference's MetricsMiddleware computed and discarded durations and
+its /metrics endpoint returned an ad-hoc JSON dump
+(pkg/server/middleware.go:214-233, handler.go:367-376 — acknowledged
+stubs). Here metrics are first-class: prometheus_client counters,
+histograms and gauges, exposed in text format at /metrics, with the
+JSON stats dump preserved at /stats for reference parity.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+try:
+    from prometheus_client import (
+        CONTENT_TYPE_LATEST,
+        CollectorRegistry,
+        Counter,
+        Gauge,
+        Histogram,
+        generate_latest,
+    )
+
+    HAVE_PROMETHEUS = True
+except Exception:  # pragma: no cover - baked into the image, but be safe
+    HAVE_PROMETHEUS = False
+
+
+_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.15, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class GatewayMetrics:
+    """All gateway-side instruments, on a private registry."""
+
+    def __init__(self) -> None:
+        if not HAVE_PROMETHEUS:
+            self.registry = None
+            return
+        self.registry = CollectorRegistry()
+        self.http_requests = Counter(
+            "gateway_http_requests_total",
+            "HTTP requests by method/path/status",
+            ["method", "path", "status"],
+            registry=self.registry,
+        )
+        self.http_latency = Histogram(
+            "gateway_http_request_seconds",
+            "HTTP request latency",
+            ["path"],
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self.rpc_requests = Counter(
+            "gateway_jsonrpc_requests_total",
+            "JSON-RPC requests by method and outcome",
+            ["rpc_method", "outcome"],
+            registry=self.registry,
+        )
+        self.tool_calls = Counter(
+            "gateway_tool_calls_total",
+            "Tool invocations by tool and outcome",
+            ["tool", "outcome"],
+            registry=self.registry,
+        )
+        self.tool_latency = Histogram(
+            "gateway_tool_call_seconds",
+            "End-to-end tool call latency",
+            ["tool"],
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self.sessions_active = Gauge(
+            "gateway_sessions_active",
+            "Live sessions",
+            registry=self.registry,
+        )
+        self.backends_healthy = Gauge(
+            "gateway_backends_healthy",
+            "Healthy backend count",
+            registry=self.registry,
+        )
+        self.rate_limited = Counter(
+            "gateway_rate_limited_total",
+            "Requests rejected by rate limiting",
+            ["scope"],  # global | session
+            registry=self.registry,
+        )
+
+    # -- recording helpers (no-ops without prometheus) ----------------------
+
+    def observe_http(self, method: str, path: str, status: int, seconds: float):
+        if self.registry is None:
+            return
+        self.http_requests.labels(method, path, str(status)).inc()
+        self.http_latency.labels(path).observe(seconds)
+
+    def observe_rpc(self, rpc_method: str, outcome: str):
+        if self.registry is None:
+            return
+        self.rpc_requests.labels(rpc_method, outcome).inc()
+
+    def observe_tool_call(self, tool: str, outcome: str, seconds: float):
+        if self.registry is None:
+            return
+        self.tool_calls.labels(tool, outcome).inc()
+        self.tool_latency.labels(tool).observe(seconds)
+
+    def rate_limit_hit(self, scope: str):
+        if self.registry is None:
+            return
+        self.rate_limited.labels(scope).inc()
+
+    def set_gauges(self, sessions: int, healthy_backends: int):
+        if self.registry is None:
+            return
+        self.sessions_active.set(sessions)
+        self.backends_healthy.set(healthy_backends)
+
+    def render(self) -> tuple[bytes, str]:
+        """Prometheus text exposition."""
+        if self.registry is None:
+            return b"# prometheus_client unavailable\n", "text/plain"
+        return generate_latest(self.registry), CONTENT_TYPE_LATEST
+
+
+class Timer:
+    __slots__ = ("start", "elapsed")
+
+    def __enter__(self) -> "Timer":
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = time.perf_counter() - self.start

@@ -3,17 +3,14 @@
 Capability parity with the reference connection layer
 (pkg/grpc/connection.go): insecure dial with keepalive and message-size
 options, connectivity-state health checking with a bounded wait, and
-reconnect. Extended beyond the reference (SURVEY.md §5.3, §5.8): an
-`EndpointPool` manages N backend channels with per-endpoint health, a
-background watchdog that actually drives reconnection (the reference's
-Reconnect was dead code), and round-robin selection over healthy
-endpoints — the shape needed for a pool of TPU-VM serving sidecars.
+reconnect. Multi-backend pooling with per-endpoint health and a
+reconnect watchdog lives in rpc/discovery.py (Backend +
+ServiceDiscoverer), built on this single-channel manager.
 """
 
 from __future__ import annotations
 
 import asyncio
-import itertools
 import logging
 import time
 from typing import Optional
@@ -59,18 +56,22 @@ class ChannelManager:
         async with self._lock:
             if self._channel is not None:
                 await self._channel.close()
-            self._channel = grpc.aio.insecure_channel(
+            channel = grpc.aio.insecure_channel(
                 self.target, options=_channel_options(self.cfg)
             )
             try:
-                await asyncio.wait_for(
-                    self._channel.channel_ready(), timeout=timeout_s
-                )
+                await asyncio.wait_for(channel.channel_ready(), timeout=timeout_s)
             except asyncio.TimeoutError:
+                # Close the half-open channel so no background connect
+                # attempts linger and `channel`/`is_connected` report
+                # disconnected.
+                self._channel = None
+                await channel.close()
                 raise ConnectionError(
                     f"timed out connecting to {self.target} after {timeout_s}s"
                 )
-            return self._channel
+            self._channel = channel
+            return channel
 
     @property
     def channel(self) -> grpc.aio.Channel:
@@ -125,128 +126,3 @@ class ChannelManager:
             if self._channel is not None:
                 await self._channel.close()
                 self._channel = None
-
-
-class Endpoint:
-    """One pooled backend: a channel manager plus health bookkeeping."""
-
-    def __init__(self, target: str, cfg: GRPCConfig):
-        self.manager = ChannelManager(target, cfg)
-        self.target = target
-        self.healthy = False
-        self.consecutive_failures = 0
-        self.last_check = 0.0
-
-    def mark(self, ok: bool) -> None:
-        self.last_check = time.monotonic()
-        if ok:
-            self.healthy = True
-            self.consecutive_failures = 0
-        else:
-            self.healthy = False
-            self.consecutive_failures += 1
-
-
-class EndpointPool:
-    """Round-robin pool of health-checked backends (per-shard endpoint
-    pool from the north star; no reference analogue — the reference held
-    exactly one channel)."""
-
-    def __init__(self, targets: list[str], cfg: Optional[GRPCConfig] = None):
-        self.cfg = cfg or GRPCConfig()
-        self.endpoints = [Endpoint(t, self.cfg) for t in targets]
-        self._rr = itertools.count()
-        self._watchdog_task: Optional[asyncio.Task] = None
-
-    async def connect_all(self, raise_if_none: bool = True) -> int:
-        """Dial every endpoint; tolerate partial failure."""
-        results = await asyncio.gather(
-            *(ep.manager.connect() for ep in self.endpoints), return_exceptions=True
-        )
-        up = 0
-        for ep, result in zip(self.endpoints, results):
-            ok = not isinstance(result, BaseException)
-            ep.mark(ok)
-            up += ok
-            if not ok:
-                logger.warning("endpoint %s failed to connect: %s", ep.target, result)
-        if up == 0 and raise_if_none and self.endpoints:
-            raise ConnectionError("no endpoints reachable")
-        return up
-
-    def pick(self) -> Endpoint:
-        """Next healthy endpoint, round-robin; raises if all are down."""
-        healthy = [ep for ep in self.endpoints if ep.healthy]
-        if not healthy:
-            raise ConnectionError("all backend endpoints unhealthy")
-        return healthy[next(self._rr) % len(healthy)]
-
-    def healthy_count(self) -> int:
-        return sum(1 for ep in self.endpoints if ep.healthy)
-
-    async def check_all(self) -> int:
-        results = await asyncio.gather(
-            *(ep.manager.health_check() for ep in self.endpoints),
-            return_exceptions=True,
-        )
-        for ep, result in zip(self.endpoints, results):
-            ep.mark(result is True)
-        return self.healthy_count()
-
-    # -- background watchdog (fixes the reference's dead Reconnect) --------
-
-    def start_watchdog(self, on_recover=None) -> None:
-        if self._watchdog_task is None:
-            self._watchdog_task = asyncio.get_running_loop().create_task(
-                self._watchdog(on_recover)
-            )
-
-    async def stop_watchdog(self) -> None:
-        if self._watchdog_task is not None:
-            self._watchdog_task.cancel()
-            try:
-                await self._watchdog_task
-            except asyncio.CancelledError:
-                pass
-            self._watchdog_task = None
-
-    async def _watchdog(self, on_recover) -> None:
-        interval = self.cfg.reconnect.watchdog_interval_s
-        while True:
-            await asyncio.sleep(interval)
-            try:
-                for ep in self.endpoints:
-                    ok = await ep.manager.health_check()
-                    was_healthy = ep.healthy
-                    if not ok and self.cfg.reconnect.enabled:
-                        ok = await self._try_reconnect(ep)
-                    ep.mark(ok)
-                    if ok and not was_healthy:
-                        logger.info("endpoint %s recovered", ep.target)
-                        if on_recover is not None:
-                            await on_recover(ep)
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                logger.exception("watchdog pass failed")
-
-    async def _try_reconnect(self, ep: Endpoint) -> bool:
-        """Bounded reconnect attempts (discovery.go:187-235 semantics,
-        actually invoked here)."""
-        for attempt in range(self.cfg.reconnect.max_attempts):
-            try:
-                await ep.manager.reconnect()
-                return True
-            except Exception as exc:
-                logger.warning(
-                    "reconnect %s attempt %d/%d failed: %s",
-                    ep.target, attempt + 1, self.cfg.reconnect.max_attempts, exc,
-                )
-                await asyncio.sleep(self.cfg.reconnect.interval_s)
-        return False
-
-    async def close(self) -> None:
-        await self.stop_watchdog()
-        await asyncio.gather(
-            *(ep.manager.close() for ep in self.endpoints), return_exceptions=True
-        )

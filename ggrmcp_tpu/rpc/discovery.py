@@ -138,6 +138,15 @@ class ServiceDiscoverer:
             try:
                 loader = DescriptorSetLoader(self.cfg.descriptor_set.path).load()
                 for mi in loader.extract_method_info():
+                    if not self._tool_allowed(mi):
+                        continue
+                    if mi.tool_name in fds_methods:
+                        logger.warning(
+                            "tool name collision in descriptor set: %s "
+                            "(%s shadows %s)",
+                            mi.tool_name, mi.full_name,
+                            fds_methods[mi.tool_name].full_name,
+                        )
                     fds_methods[mi.tool_name] = mi
                 logger.info(
                     "descriptor set: %d methods from %s",
@@ -158,15 +167,30 @@ class ServiceDiscoverer:
                 logger.warning("discovery failed for %s: %s", backend.target, exc)
                 continue
             for mi in methods:
-                if mi.is_streaming and not self.allow_streaming_tools:
+                if not self._tool_allowed(mi):
                     continue
                 fds_mi = fds_methods.get(mi.tool_name)
-                if fds_mi is not None and self.cfg.descriptor_set.prefer_over_reflection:
-                    # FDS wins for metadata (comments) but keeps the live
-                    # backend's descriptors for invocation compatibility.
-                    mi.description = mi.description or fds_mi.description
-                    mi.service_description = (
-                        mi.service_description or fds_mi.service_description
+                if fds_mi is not None:
+                    # Metadata merge: with prefer_over_reflection the
+                    # FDS text (richer protoc comments) wins; otherwise
+                    # FDS only fills gaps reflection left empty. Live
+                    # descriptors always come from the backend.
+                    if self.cfg.descriptor_set.prefer_over_reflection:
+                        mi.description = fds_mi.description or mi.description
+                        mi.service_description = (
+                            fds_mi.service_description or mi.service_description
+                        )
+                    else:
+                        mi.description = mi.description or fds_mi.description
+                        mi.service_description = (
+                            mi.service_description or fds_mi.service_description
+                        )
+                if mi.tool_name in registry:
+                    logger.warning(
+                        "tool name collision across backends: %s (%s on %s "
+                        "shadows %s)",
+                        mi.tool_name, mi.full_name, backend.target,
+                        registry[mi.tool_name][0].full_name,
                     )
                 registry[mi.tool_name] = (mi, backend)
 
@@ -180,6 +204,16 @@ class ServiceDiscoverer:
         self._tools = registry  # atomic swap
         logger.info("tool registry: %d tools", len(registry))
         return len(registry)
+
+    def _tool_allowed(self, mi: MethodInfo) -> bool:
+        """Streaming gating applied uniformly to reflection- and
+        FDS-discovered methods: client streaming is never servable;
+        server streaming only when enabled."""
+        if mi.is_client_streaming:
+            return False
+        if mi.is_server_streaming and not self.allow_streaming_tools:
+            return False
+        return True
 
     async def close(self) -> None:
         await self.stop_watchdog()

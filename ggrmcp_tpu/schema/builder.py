@@ -360,19 +360,26 @@ class ToolBuilder:
         output_schema = None
         if self.cfg.emit_output_schema and method.output_descriptor is not None:
             output_schema = self.schema_builder.message_schema(method.output_descriptor)
+        annotations = {}
+        if method.is_server_streaming:
+            annotations["x-streaming"] = True
         return Tool(
             name=name,
             description=description,
             input_schema=input_schema,
             output_schema=output_schema,
+            annotations=annotations,
         )
 
     def build_tools(self, methods: list[MethodInfo]) -> list[Tool]:
-        """Build all tools; skip streaming methods and log-and-skip
-        failures (builder.go:125-151)."""
+        """Build all tools, log-and-skip failures (builder.go:125-151).
+        Client-streaming methods are never exposed; server-streaming
+        ones are included when cfg.streaming_tools is set."""
         tools: list[Tool] = []
         for method in methods:
-            if method.is_streaming and not method.options.get("mcp_streaming"):
+            if method.is_client_streaming:
+                continue
+            if method.is_server_streaming and not self.cfg.streaming_tools:
                 continue
             try:
                 tools.append(self.build_tool(method))

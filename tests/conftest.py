@@ -7,7 +7,10 @@ story): the env vars must be set before jax is first imported anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the environment pins JAX_PLATFORMS=axon (the real TPU via a
+# tunnel) which is slow to claim and single-chip; tests run on a virtual
+# 8-device CPU mesh instead. bench.py keeps the real TPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,319 @@
+"""Full-stack HTTP gateway tests: JSON-RPC flows through middleware +
+handler + discovery + in-process gRPC backend
+(tests/integration_test.go + ci.yml end-to-end parity)."""
+
+import contextlib
+import json
+
+import aiohttp
+import pytest
+
+from ggrmcp_tpu.core import config as cfgmod
+from ggrmcp_tpu.gateway.app import Gateway
+from tests.backend_utils import MAGIC_ERROR_USER, InProcessBackend
+
+SESSION_HEADER = "Mcp-Session-Id"
+
+
+def gateway_config(**overrides) -> cfgmod.Config:
+    cfg = cfgmod.default()
+    cfg.server.host = "127.0.0.1"
+    cfg.server.port = 0
+    cfg.grpc.connect_timeout_s = 5.0
+    cfg.grpc.reconnect.enabled = False
+    for key, value in overrides.items():
+        section, _, attr = key.partition(".")
+        obj = getattr(cfg, section)
+        setattr(obj, attr, value)
+    return cfg
+
+
+@contextlib.asynccontextmanager
+async def gateway_env(cfg=None):
+    async with InProcessBackend() as backend:
+        gw = Gateway(cfg or gateway_config(), targets=[backend.target])
+        await gw.start()
+        base = f"http://127.0.0.1:{gw.port}"
+        async with aiohttp.ClientSession(base_url=base) as client:
+            try:
+                yield backend, gw, client
+            finally:
+                await gw.stop()
+
+
+async def rpc(client, method, params=None, id_=1, headers=None):
+    body = {"jsonrpc": "2.0", "method": method, "id": id_}
+    if params is not None:
+        body["params"] = params
+    return await client.post("/", json=body, headers=headers or {})
+
+
+class TestCapabilities:
+    async def test_get_initialize(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await client.get("/")
+            assert resp.status == 200
+            assert SESSION_HEADER in resp.headers
+            data = await resp.json()
+            result = data["result"]
+            assert result["protocolVersion"] == "2024-11-05"
+            assert result["serverInfo"]["name"] == "ggrmcp-tpu"
+            assert "tools" in result["capabilities"]
+
+    async def test_post_initialize(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(client, "initialize", {"capabilities": {}})
+            data = await resp.json()
+            assert data["id"] == 1
+            assert data["result"]["protocolVersion"] == "2024-11-05"
+
+    async def test_notification_accepted(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await client.post(
+                "/", json={"jsonrpc": "2.0", "method": "notifications/initialized"}
+            )
+            assert resp.status == 202
+
+    async def test_ping(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(client, "ping")
+            assert (await resp.json())["result"] == {}
+
+
+class TestToolsList:
+    async def test_tools_listed_with_schemas(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(client, "tools/list")
+            tools = (await resp.json())["result"]["tools"]
+            by_name = {t["name"]: t for t in tools}
+            assert "hello_helloservice_sayhello" in by_name
+            hello = by_name["hello_helloservice_sayhello"]
+            assert hello["inputSchema"]["properties"]["name"] == {"type": "string"}
+            assert "outputSchema" in hello
+            # complex service schemas survive the full stack
+            profile = by_name["complexdemo_profileservice_upsertprofile"]
+            props = profile["inputSchema"]["properties"]["profile"]["properties"]
+            assert props["tier"]["type"] == "string"
+            assert "ACCOUNT_TIER_PRO" in props["tier"]["enum"]
+
+    async def test_streaming_tool_listed(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(client, "tools/list")
+            tools = (await resp.json())["result"]["tools"]
+            names = {t["name"] for t in tools}
+            assert "complexdemo_streamservice_watch" in names
+
+
+class TestToolsCall:
+    async def test_call_roundtrip(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(
+                client, "tools/call",
+                {"name": "hello_helloservice_sayhello", "arguments": {"name": "MCP"}},
+            )
+            data = await resp.json()
+            content = data["result"]["content"]
+            assert len(content) == 1
+            payload = json.loads(content[0]["text"])
+            assert payload == {"message": "Hello, MCP!"}
+            assert "isError" not in data["result"]
+
+    async def test_unknown_tool_is_protocol_error(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(
+                client, "tools/call", {"name": "missing_tool", "arguments": {}}
+            )
+            data = await resp.json()
+            assert resp.status == 200  # JSON-RPC errors ride HTTP 200
+            assert data["error"]["code"] == -32601
+
+    async def test_backend_error_is_iserror_result(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(
+                client, "tools/call",
+                {
+                    "name": "complexdemo_profileservice_getprofile",
+                    "arguments": {"userId": MAGIC_ERROR_USER},
+                },
+            )
+            data = await resp.json()
+            assert "error" not in data
+            result = data["result"]
+            assert result["isError"] is True
+            assert "backend exploded" in result["content"][0]["text"]
+
+    async def test_invalid_arguments_is_invalid_params(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(
+                client, "tools/call",
+                {"name": "hello_helloservice_sayhello", "arguments": {"bogus": 1}},
+            )
+            data = await resp.json()
+            assert data["error"]["code"] == -32602
+
+    async def test_streaming_tool_aggregated(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(
+                client, "tools/call",
+                {
+                    "name": "complexdemo_streamservice_watch",
+                    "arguments": {"userId": "w"},
+                },
+            )
+            data = await resp.json()
+            content = data["result"]["content"]
+            assert len(content) == 3
+            assert json.loads(content[0]["text"])["profile"]["displayName"] == "update-0"
+
+    async def test_streaming_tool_sse(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(
+                client, "tools/call",
+                {
+                    "name": "complexdemo_streamservice_watch",
+                    "arguments": {"userId": "w"},
+                },
+                headers={"Accept": "text/event-stream"},
+            )
+            assert resp.headers["Content-Type"].startswith("text/event-stream")
+            text = await resp.text()
+            events = [e for e in text.split("\n\n") if e.strip()]
+            chunk_events = [e for e in events if e.startswith("event: chunk")]
+            result_events = [e for e in events if e.startswith("event: result")]
+            assert len(chunk_events) == 3
+            assert len(result_events) == 1
+            final = json.loads(result_events[0].split("data: ", 1)[1])
+            assert len(final["result"]["content"]) == 3
+
+
+class TestErrors:
+    async def test_parse_error(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await client.post(
+                "/", data=b"{nope", headers={"Content-Type": "application/json"}
+            )
+            data = await resp.json()
+            assert data["error"]["code"] == -32700
+
+    async def test_method_not_found(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(client, "bogus/method")
+            data = await resp.json()
+            assert data["error"]["code"] == -32601
+
+    async def test_invalid_version(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await client.post(
+                "/", json={"jsonrpc": "1.0", "method": "ping", "id": 1}
+            )
+            data = await resp.json()
+            assert data["error"]["code"] == -32600
+
+    async def test_wrong_content_type_415(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await client.post(
+                "/", data=b"hi", headers={"Content-Type": "text/plain"}
+            )
+            assert resp.status == 415
+
+    async def test_oversize_request_413(self):
+        cfg = gateway_config(**{"server.max_request_bytes": 200})
+        async with gateway_env(cfg) as (_, _gw, client):
+            resp = await client.post(
+                "/",
+                data=b"x" * 1000,
+                headers={"Content-Type": "application/json"},
+            )
+            assert resp.status == 413
+
+
+class TestSessions:
+    async def test_session_echo_and_continuity(self):
+        async with gateway_env() as (_, _gw, client):
+            r1 = await rpc(client, "ping")
+            sid = r1.headers[SESSION_HEADER]
+            assert sid
+            r2 = await rpc(client, "ping", headers={SESSION_HEADER: sid})
+            assert r2.headers[SESSION_HEADER] == sid
+
+    async def test_unknown_session_gets_fresh(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(client, "ping", headers={SESSION_HEADER: "bogus"})
+            assert resp.headers[SESSION_HEADER] != "bogus"
+
+    async def test_session_rate_limit_enforced(self):
+        cfg = gateway_config()
+        cfg.session.rate_limit.requests_per_minute = 3
+        async with gateway_env(cfg) as (_, _gw, client):
+            r1 = await rpc(client, "ping")
+            sid = r1.headers[SESSION_HEADER]
+            codes = []
+            for _ in range(5):
+                resp = await rpc(client, "ping", headers={SESSION_HEADER: sid})
+                data = await resp.json()
+                codes.append("error" in data)
+            assert any(codes), "rate limit never triggered"
+
+    async def test_header_forwarding_through_session(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(
+                client, "tools/call",
+                {"name": "hello_helloservice_sayhello", "arguments": {"name": "h"}},
+                headers={"Authorization": "Bearer tok", "X-Trace-Id": "t1"},
+            )
+            data = await resp.json()
+            assert "error" not in data
+
+
+class TestOpsEndpoints:
+    async def test_health_healthy(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await client.get("/health")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["status"] == "healthy"
+            assert body["methodCount"] == 5
+
+    async def test_metrics_prometheus_format(self):
+        async with gateway_env() as (_, _gw, client):
+            await rpc(client, "tools/call",
+                      {"name": "hello_helloservice_sayhello",
+                       "arguments": {"name": "m"}})
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            text = await resp.text()
+            assert "gateway_tool_calls_total" in text
+            assert 'tool="hello_helloservice_sayhello"' in text
+
+    async def test_stats_json(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await client.get("/stats")
+            body = await resp.json()
+            assert body["methodCount"] == 5
+            assert body["serviceCount"] == 4
+            assert "sessions" in body
+
+    async def test_security_headers(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await client.get("/health")
+            assert resp.headers["X-Content-Type-Options"] == "nosniff"
+            assert resp.headers["X-Frame-Options"] == "DENY"
+
+    async def test_cors_preflight(self):
+        async with gateway_env() as (_, _gw, client):
+            resp = await client.options("/", headers={"Origin": "http://x"})
+            assert resp.headers["Access-Control-Allow-Origin"]
+            assert SESSION_HEADER in resp.headers["Access-Control-Expose-Headers"]
+
+
+class TestRateLimit:
+    async def test_global_rate_limit_429(self):
+        cfg = gateway_config()
+        cfg.server.rate_limit.requests_per_second = 1.0
+        cfg.server.rate_limit.burst = 2
+        async with gateway_env(cfg) as (_, _gw, client):
+            statuses = []
+            for _ in range(6):
+                resp = await client.get("/health")
+                statuses.append(resp.status)
+            assert 429 in statuses

@@ -173,8 +173,22 @@ class TestToolBuilder:
         )
         assert tb.build_tool(mi).description == "Greets people."
 
-    def test_streaming_skipped(self):
+    def test_server_streaming_included_with_annotation(self):
         tb = ToolBuilder()
+        streaming = self._mi(
+            "complexdemo.StreamService", "Watch",
+            complex_pb2.GetProfileRequest.DESCRIPTOR,
+            complex_pb2.ProfileResponse.DESCRIPTOR,
+            is_server_streaming=True,
+        )
+        tools = tb.build_tools([streaming])
+        assert [t.name for t in tools] == ["complexdemo_streamservice_watch"]
+        assert tools[0].annotations["x-streaming"] is True
+
+    def test_streaming_skipped_when_disabled(self):
+        from ggrmcp_tpu.core.config import ToolsConfig
+
+        tb = ToolBuilder(ToolsConfig(streaming_tools=False))
         unary = self._mi(
             "hello.HelloService", "SayHello",
             hello_pb2.HelloRequest.DESCRIPTOR, hello_pb2.HelloResponse.DESCRIPTOR,
@@ -185,7 +199,13 @@ class TestToolBuilder:
             complex_pb2.ProfileResponse.DESCRIPTOR,
             is_server_streaming=True,
         )
-        tools = tb.build_tools([unary, streaming])
+        client_streaming = self._mi(
+            "complexdemo.StreamService", "Upload",
+            complex_pb2.GetProfileRequest.DESCRIPTOR,
+            complex_pb2.ProfileResponse.DESCRIPTOR,
+            is_client_streaming=True,
+        )
+        tools = tb.build_tools([unary, streaming, client_streaming])
         assert [t.name for t in tools] == ["hello_helloservice_sayhello"]
 
     def test_broken_method_skipped(self):
