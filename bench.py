@@ -1,0 +1,178 @@
+"""Benchmark: MCP tool-calls/sec + p50 end-to-end latency through the
+FULL stack — HTTP gateway → discovery → gRPC → TPU sidecar → jitted
+sharded model (BASELINE.md north-star metric).
+
+Prints ONE JSON line:
+  {"metric": "mcp_generate_calls_per_sec", "value": N, "unit": "calls/s",
+   "vs_baseline": N/1000, ...extras}
+
+vs_baseline is measured against the BASELINE.json target of 1,000 MCP
+tool-calls/s (the reference publishes no numbers of its own —
+BASELINE.md).
+
+Environment knobs:
+  GGRMCP_BENCH_MODEL     model registry key (default: platform-dependent)
+  GGRMCP_BENCH_SESSIONS  concurrent MCP sessions (default 16)
+  GGRMCP_BENCH_CALLS     total tool calls (default 10 * sessions)
+  GGRMCP_BENCH_NEW_TOKENS max_new_tokens per call (default 16)
+  GGRMCP_BENCH_CPU=1     force the CPU platform (tiny model)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def _setup_jax():
+    """Pick the platform: real TPU (axon) when available, else CPU."""
+    force_cpu = os.environ.get("GGRMCP_BENCH_CPU") == "1"
+    if force_cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+        )
+    import jax
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        devices = jax.devices()
+    except RuntimeError as exc:
+        print(f"bench: TPU unavailable ({exc}); falling back to CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices()
+    return devices
+
+
+async def _run_bench() -> dict:
+    devices = _setup_jax()
+    platform = devices[0].platform
+    on_tpu = platform == "tpu"
+
+    import aiohttp
+
+    from ggrmcp_tpu.core import config as cfgmod
+    from ggrmcp_tpu.core.config import BatchingConfig, MeshConfig, ServingConfig
+    from ggrmcp_tpu.gateway.app import Gateway
+    from ggrmcp_tpu.serving.sidecar import Sidecar
+
+    model = os.environ.get(
+        "GGRMCP_BENCH_MODEL", "llama-1b" if on_tpu else "tiny-llama"
+    )
+    sessions = int(os.environ.get("GGRMCP_BENCH_SESSIONS", "16"))
+    total_calls = int(
+        os.environ.get("GGRMCP_BENCH_CALLS", str(10 * sessions))
+    )
+    max_new = int(os.environ.get("GGRMCP_BENCH_NEW_TOKENS", "16"))
+
+    serving = ServingConfig(
+        model=model,
+        mesh=MeshConfig(tensor=0),  # all local devices on the tensor axis
+        batching=BatchingConfig(
+            max_batch_size=min(32, max(8, sessions)),
+            kv_cache_max_seq=512,
+        ),
+    )
+    sidecar = Sidecar(serving)
+    port = await sidecar.start(0)
+
+    cfg = cfgmod.default()
+    cfg.server.host = "127.0.0.1"
+    cfg.server.port = 0
+    cfg.server.rate_limit.enabled = False
+    cfg.session.rate_limit.enabled = False
+    cfg.grpc.reconnect.enabled = False
+    gateway = Gateway(cfg, targets=[f"localhost:{port}"])
+    await gateway.start()
+
+    base = f"http://127.0.0.1:{gateway.port}"
+    tool = "ggrmcp_tpu_generateservice_generate"
+    latencies: list[float] = []
+
+    async with aiohttp.ClientSession(base_url=base) as client:
+        # Warmup: trigger discovery listing + XLA compilation.
+        body = {
+            "jsonrpc": "2.0", "method": "tools/call", "id": 0,
+            "params": {
+                "name": tool,
+                "arguments": {"prompt": "warmup", "maxNewTokens": max_new},
+            },
+        }
+        t0 = time.perf_counter()
+        resp = await client.post("/", json=body)
+        data = await resp.json()
+        if "error" in data:
+            raise RuntimeError(f"warmup failed: {data['error']}")
+        warmup_s = time.perf_counter() - t0
+
+        calls_per_session = max(1, total_calls // sessions)
+        total = calls_per_session * sessions
+
+        async def session_worker(sid: int):
+            headers: dict[str, str] = {}
+            for i in range(calls_per_session):
+                body = {
+                    "jsonrpc": "2.0", "method": "tools/call",
+                    "id": sid * 1000 + i,
+                    "params": {
+                        "name": tool,
+                        "arguments": {
+                            "prompt": f"session {sid} call {i}",
+                            "maxNewTokens": max_new,
+                            "sampling": {"temperature": 0.7,
+                                         "seed": str(sid * 7919 + i)},
+                        },
+                    },
+                }
+                t = time.perf_counter()
+                resp = await client.post("/", json=body, headers=headers)
+                data = await resp.json()
+                latencies.append(time.perf_counter() - t)
+                sid_header = resp.headers.get("Mcp-Session-Id")
+                if sid_header:
+                    headers["Mcp-Session-Id"] = sid_header
+                if "error" in data:
+                    raise RuntimeError(f"call failed: {data['error']}")
+
+        bench_start = time.perf_counter()
+        await asyncio.gather(*(session_worker(s) for s in range(sessions)))
+        elapsed = time.perf_counter() - bench_start
+
+    await gateway.stop()
+    await sidecar.stop()
+
+    calls_per_sec = total / elapsed
+    p50 = statistics.median(latencies) * 1000
+    p99 = sorted(latencies)[int(len(latencies) * 0.99) - 1] * 1000
+    n_chips = len(devices) if on_tpu else 1
+    return {
+        "metric": "mcp_generate_calls_per_sec",
+        "value": round(calls_per_sec, 2),
+        "unit": "calls/s",
+        "vs_baseline": round(calls_per_sec / 1000.0, 4),
+        "p50_ms": round(p50, 1),
+        "p99_ms": round(p99, 1),
+        "platform": platform,
+        "chips": n_chips,
+        "calls_per_sec_per_chip": round(calls_per_sec / n_chips, 2),
+        "model": model,
+        "sessions": sessions,
+        "total_calls": total,
+        "max_new_tokens": max_new,
+        "tokens_per_sec": round(calls_per_sec * max_new, 1),
+        "warmup_s": round(warmup_s, 1),
+    }
+
+
+def main() -> None:
+    result = asyncio.run(_run_bench())
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -270,7 +270,10 @@ class ServingConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     batching: BatchingConfig = field(default_factory=BatchingConfig)
     port: int = 50051
+    # Orbax checkpoint directory with model params (empty → random init).
     checkpoint_path: str = ""
+    # HuggingFace tokenizer.json path (empty → hermetic byte tokenizer).
+    tokenizer_path: str = ""
 
 
 # ---------------------------------------------------------------------------

@@ -1,1 +1,26 @@
-"""models subpackage."""
+"""Model registry: name → (family, config).
+
+The serving sidecar resolves `ServingConfig.model` here. Families:
+"llama" (generation) and "bert" (embeddings).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ggrmcp_tpu.models import bert, llama
+
+
+def get_model(name: str) -> tuple[str, Any]:
+    if name in llama.CONFIGS:
+        return "llama", llama.CONFIGS[name]
+    if name in bert.CONFIGS:
+        return "bert", bert.CONFIGS[name]
+    raise KeyError(
+        f"unknown model {name!r}; available: "
+        f"{sorted([*llama.CONFIGS, *bert.CONFIGS])}"
+    )
+
+
+def available_models() -> list[str]:
+    return sorted([*llama.CONFIGS, *bert.CONFIGS])

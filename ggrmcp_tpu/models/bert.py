@@ -1,0 +1,157 @@
+"""BERT-class bidirectional encoder for the embedding endpoint
+(BASELINE.md config #2: BERT-base embeddings on v5e-1).
+
+Pure functional like the Llama model: stacked per-layer params, one
+scanned encoder block, pooling at the end. Tensor-parallel specs are
+provided for completeness, though the embed endpoint's bench target is
+a single chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ggrmcp_tpu.models import common
+from ggrmcp_tpu.ops.attention import attention_xla
+
+Params = common.Params
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig(common.ModelConfig):
+    name: str = "bert"
+    vocab_size: int = 30522
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    head_dim: int = 64
+    ffn_dim: int = 3072
+    max_seq_len: int = 512
+    norm_eps: float = 1e-12
+    dtype: str = "bfloat16"
+    pad_token_id: int = 0
+
+
+CONFIGS: dict[str, BertConfig] = {
+    "bert-tiny": BertConfig(
+        name="bert-tiny", vocab_size=30522, hidden_dim=128, num_layers=2,
+        num_heads=2, head_dim=64, ffn_dim=512, max_seq_len=512,
+        dtype="float32",
+    ),
+    "bert-base": BertConfig(name="bert-base"),
+}
+
+
+def init_params(key: jax.Array, cfg: BertConfig) -> Params:
+    dtype = cfg.jnp_dtype
+    keys = jax.random.split(key, 8)
+    d, l = cfg.hidden_dim, cfg.num_layers
+    scale = d**-0.5
+    return {
+        "embed": common.init_dense(keys[0], cfg.vocab_size, d, dtype, scale=0.02),
+        "pos_embed": common.init_dense(keys[1], cfg.max_seq_len, d, dtype, scale=0.02),
+        "embed_norm_w": jnp.ones((d,), dtype),
+        "embed_norm_b": jnp.zeros((d,), dtype),
+        "layers": {
+            "wqkv": common.init_stacked(keys[2], l, (d, 3 * d), dtype, scale),
+            "wo": common.init_stacked(keys[3], l, (d, d), dtype, scale),
+            "attn_norm_w": jnp.ones((l, d), dtype),
+            "attn_norm_b": jnp.zeros((l, d), dtype),
+            "w_in": common.init_stacked(keys[4], l, (d, cfg.ffn_dim), dtype, scale),
+            "w_out": common.init_stacked(
+                keys[5], l, (cfg.ffn_dim, d), dtype, scale=cfg.ffn_dim**-0.5
+            ),
+            "mlp_norm_w": jnp.ones((l, d), dtype),
+            "mlp_norm_b": jnp.zeros((l, d), dtype),
+        },
+    }
+
+
+def param_specs(cfg: BertConfig) -> Params:
+    return {
+        "embed": P("tensor", None),
+        "pos_embed": P(None, None),
+        "embed_norm_w": P(None),
+        "embed_norm_b": P(None),
+        "layers": {
+            "wqkv": P(None, None, "tensor"),
+            "wo": P(None, "tensor", None),
+            "attn_norm_w": P(None, None),
+            "attn_norm_b": P(None, None),
+            "w_in": P(None, None, "tensor"),
+            "w_out": P(None, "tensor", None),
+            "mlp_norm_w": P(None, None),
+            "mlp_norm_b": P(None, None),
+        },
+    }
+
+
+def encode(
+    params: Params,
+    cfg: BertConfig,
+    tokens: jnp.ndarray,  # [B, S]
+    attention_mask: Optional[jnp.ndarray] = None,  # [B, S] 1=real
+) -> jnp.ndarray:  # [B, S, D] final hidden states
+    b, s = tokens.shape
+    if attention_mask is None:
+        attention_mask = (tokens != cfg.pad_token_id).astype(jnp.int32)
+    x = params["embed"].astype(cfg.jnp_dtype)[tokens]
+    x = x + params["pos_embed"][None, :s]
+    x = common.layer_norm(
+        x, params["embed_norm_w"], params["embed_norm_b"], cfg.norm_eps
+    )
+    # Padding is masked by clamping kv_len per batch row (pads are
+    # assumed trailing, the tokenizer's contract).
+    kv_len = attention_mask.sum(axis=-1).astype(jnp.int32)  # [B]
+    h, hd = cfg.num_heads, cfg.head_dim
+
+    def body(x, layer_params):
+        normed_in = x
+        qkv = x @ layer_params["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, h, hd)
+        k = k.reshape(b, s, h, hd)
+        v = v.reshape(b, s, h, hd)
+        attn = attention_xla(q, k, v, causal=False, kv_len=kv_len)
+        attn = attn.reshape(b, s, h * hd) @ layer_params["wo"]
+        x = common.layer_norm(
+            normed_in + attn,
+            layer_params["attn_norm_w"], layer_params["attn_norm_b"],
+            cfg.norm_eps,
+        )
+        mlp = jax.nn.gelu(x @ layer_params["w_in"]) @ layer_params["w_out"]
+        x = common.layer_norm(
+            x + mlp,
+            layer_params["mlp_norm_w"], layer_params["mlp_norm_b"],
+            cfg.norm_eps,
+        )
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
+
+
+def embed(
+    params: Params,
+    cfg: BertConfig,
+    tokens: jnp.ndarray,  # [B, S]
+    attention_mask: Optional[jnp.ndarray] = None,
+    pooling: str = "mean",  # static: mean | cls | max
+) -> jnp.ndarray:  # [B, D] float32, L2-normalized
+    if attention_mask is None:
+        attention_mask = (tokens != cfg.pad_token_id).astype(jnp.int32)
+    hidden = encode(params, cfg, tokens, attention_mask).astype(jnp.float32)
+    mask = attention_mask[..., None].astype(jnp.float32)  # [B, S, 1]
+    if pooling == "cls":
+        pooled = hidden[:, 0]
+    elif pooling == "max":
+        pooled = jnp.max(jnp.where(mask > 0, hidden, -jnp.inf), axis=1)
+    else:  # mean
+        pooled = (hidden * mask).sum(axis=1) / jnp.maximum(mask.sum(axis=1), 1.0)
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-9)

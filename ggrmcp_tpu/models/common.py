@@ -1,0 +1,91 @@
+"""Shared model building blocks: norms, initializers, config base.
+
+Models in this package are pure functional JAX: parameters are nested
+dict pytrees, forward passes are plain functions closed over a static
+config, and per-layer parameters are STACKED along a leading layer axis
+so the decoder loop is a single `lax.scan` body — one compiled layer,
+fast XLA compiles, PP-friendly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Base config; frozen → hashable → usable as a jit static arg."""
+
+    name: str = "model"
+    vocab_size: int = 32000
+    hidden_dim: int = 512
+    num_layers: int = 4
+    num_heads: int = 8
+    head_dim: int = 64
+    max_seq_len: int = 2048
+    dtype: str = "bfloat16"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm in float32, cast back (Llama-style)."""
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * weight
+
+
+def layer_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray, eps: float = 1e-12
+) -> jnp.ndarray:
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    normed = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return normed.astype(x.dtype) * weight + bias
+
+
+def init_dense(
+    key: jax.Array, in_dim: int, out_dim: int, dtype, scale: float | None = None
+) -> jnp.ndarray:
+    """Truncated-normal fan-in init, stored in model dtype."""
+    scale = scale if scale is not None else in_dim**-0.5
+    return (
+        jax.random.truncated_normal(key, -2.0, 2.0, (in_dim, out_dim), jnp.float32)
+        * scale
+    ).astype(dtype)
+
+
+def init_stacked(
+    key: jax.Array,
+    num_layers: int,
+    shape: tuple[int, ...],
+    dtype,
+    scale: float,
+) -> jnp.ndarray:
+    """One stacked parameter for all layers: [L, *shape]."""
+    return (
+        jax.random.truncated_normal(
+            key, -2.0, 2.0, (num_layers, *shape), jnp.float32
+        )
+        * scale
+    ).astype(dtype)
+
+
+def count_params(params: Params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def tree_cast(params: Params, dtype) -> Params:
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        params,
+    )

@@ -1,0 +1,281 @@
+"""Llama-family decoder: pure functional, scan-over-layers, GQA + RoPE,
+tensor-parallel sharding specs for pjit over the device mesh.
+
+Design (TPU-first, not a port — the reference has no model code):
+
+- Per-layer weights are stacked [L, ...] and the decoder is one
+  `lax.scan` over layers: a single compiled block, minimal XLA compile
+  time, and the natural substrate for pipeline staging.
+- KV cache is part of the functional state: `(k, v)` arrays of shape
+  [L, B, S_max, KVH, Dh] threaded through scan; prefill and decode are
+  the same `forward` with different sequence lengths — one compiled
+  graph per (B, S) bucket.
+- Tensor parallelism is expressed as `PartitionSpec`s over the `tensor`
+  mesh axis (column-split QKV/gate/up, row-split O/down). XLA inserts
+  the all-reduces over ICI; nothing is hand-rolled.
+- Long-context: activations can be sequence-sharded with the `sequence`
+  axis (see param/activation specs); ring attention lives in
+  ops/ring_attention.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ggrmcp_tpu.models import common
+from ggrmcp_tpu.ops.attention import attention
+from ggrmcp_tpu.ops.rope import apply_rope
+
+Params = common.Params
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig(common.ModelConfig):
+    name: str = "llama"
+    vocab_size: int = 32000
+    hidden_dim: int = 512
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: int = 4
+    head_dim: int = 64
+    ffn_dim: int = 1408
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+
+# Known configurations. llama3-8b mirrors the published Llama-3-8B
+# architecture (the BASELINE.md target model on v5e-8).
+CONFIGS: dict[str, LlamaConfig] = {
+    "tiny-llama": LlamaConfig(
+        name="tiny-llama", vocab_size=512, hidden_dim=256, num_layers=4,
+        num_heads=8, num_kv_heads=4, head_dim=32, ffn_dim=704,
+        max_seq_len=1024, dtype="float32",
+    ),
+    "llama-1b": LlamaConfig(
+        name="llama-1b", vocab_size=32000, hidden_dim=2048, num_layers=16,
+        num_heads=32, num_kv_heads=8, head_dim=64, ffn_dim=5632,
+        max_seq_len=4096,
+    ),
+    "llama3-8b": LlamaConfig(
+        name="llama3-8b", vocab_size=128256, hidden_dim=4096, num_layers=32,
+        num_heads=32, num_kv_heads=8, head_dim=128, ffn_dim=14336,
+        max_seq_len=8192, rope_theta=500000.0,
+    ),
+}
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+    dtype = cfg.jnp_dtype
+    keys = jax.random.split(key, 10)
+    d, l = cfg.hidden_dim, cfg.num_layers
+    qkv_out = (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
+    scale = d**-0.5
+    return {
+        "embed": common.init_dense(keys[0], cfg.vocab_size, d, dtype, scale=0.02),
+        "layers": {
+            "attn_norm": jnp.ones((l, d), dtype),
+            "wqkv": common.init_stacked(keys[1], l, (d, qkv_out), dtype, scale),
+            "wo": common.init_stacked(
+                keys[2], l, (cfg.num_heads * cfg.head_dim, d), dtype,
+                scale=(cfg.num_heads * cfg.head_dim) ** -0.5,
+            ),
+            "mlp_norm": jnp.ones((l, d), dtype),
+            "w_gate": common.init_stacked(keys[3], l, (d, cfg.ffn_dim), dtype, scale),
+            "w_up": common.init_stacked(keys[4], l, (d, cfg.ffn_dim), dtype, scale),
+            "w_down": common.init_stacked(
+                keys[5], l, (cfg.ffn_dim, d), dtype, scale=cfg.ffn_dim**-0.5
+            ),
+        },
+        "final_norm": jnp.ones((d,), dtype),
+        "lm_head": common.init_dense(keys[6], d, cfg.vocab_size, dtype, scale),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> Params:
+    """PartitionSpecs matching init_params' structure: TP over `tensor`
+    (column-parallel in-projections, row-parallel out-projections),
+    embedding/lm_head vocab-sharded."""
+    return {
+        "embed": P("tensor", None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wqkv": P(None, None, "tensor"),
+            "wo": P(None, "tensor", None),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, "tensor"),
+            "w_up": P(None, None, "tensor"),
+            "w_down": P(None, "tensor", None),
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, "tensor"),
+    }
+
+
+def activation_spec() -> P:
+    """[B, S, D] activations: batch over data/fsdp, sequence over the
+    sequence axis (long-context SP)."""
+    return P(("data", "fsdp"), "sequence", None)
+
+
+# ---------------------------------------------------------------------------
+# KV cache
+# ---------------------------------------------------------------------------
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [L, B, S_max, KVH, Dh]
+    v: jnp.ndarray  # [L, B, S_max, KVH, Dh]
+    length: jnp.ndarray  # [B] int32 — valid prefix length
+
+    @classmethod
+    def create(cls, cfg: LlamaConfig, batch: int, max_len: int) -> "KVCache":
+        shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+        dtype = cfg.jnp_dtype
+        return cls(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            length=jnp.zeros((batch,), jnp.int32),
+        )
+
+
+def cache_specs() -> KVCache:
+    """KV cache sharding: batch over data, heads over tensor."""
+    spec = P(None, ("data", "fsdp"), None, "tensor", None)
+    return KVCache(k=spec, v=spec, length=P(("data", "fsdp")))
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _layer(
+    x: jnp.ndarray,  # [B, S, D]
+    layer_params: Params,  # one layer's slice (no leading L)
+    cfg: LlamaConfig,
+    positions: jnp.ndarray,  # [B, S]
+    cache_k: Optional[jnp.ndarray],  # [B, S_max, KVH, Dh]
+    cache_v: Optional[jnp.ndarray],
+    cache_len: Optional[jnp.ndarray],  # [B]
+):
+    b, s, d = x.shape
+    h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    # Attention
+    normed = common.rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
+    qkv = normed @ layer_params["wqkv"]  # [B, S, (H+2KVH)*Dh]
+    q, kv = jnp.split(qkv, [h * hd], axis=-1)
+    k, v = jnp.split(kv, 2, axis=-1)
+    q = q.reshape(b, s, h, hd)
+    k = k.reshape(b, s, kvh, hd)
+    v = v.reshape(b, s, kvh, hd)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+
+    if cache_k is not None:
+        # Write new K/V at each sequence's current length, then attend
+        # over the full cache prefix. Scatter via one-hot matmul-free
+        # dynamic update: positions are per-batch, so use advanced
+        # indexing with explicit batch indices (compiles to scatter).
+        batch_idx = jnp.arange(b)[:, None]  # [B, 1]
+        write_pos = cache_len[:, None] + jnp.arange(s)[None, :]  # [B, S]
+        cache_k = cache_k.at[batch_idx, write_pos].set(k)
+        cache_v = cache_v.at[batch_idx, write_pos].set(v)
+        k_all, v_all = cache_k, cache_v
+        kv_len = cache_len + s
+        q_offset = cache_len
+    else:
+        k_all, v_all, kv_len, q_offset = k, v, None, None
+
+    # GQA: repeat KV heads to match query heads.
+    if kvh != h:
+        reps = h // kvh
+        k_all = jnp.repeat(k_all, reps, axis=2)
+        v_all = jnp.repeat(v_all, reps, axis=2)
+
+    attn_out = attention(
+        q, k_all, v_all, causal=True, q_offset=q_offset, kv_len=kv_len
+    )
+    attn_out = attn_out.reshape(b, s, h * hd) @ layer_params["wo"]
+    x = x + attn_out
+
+    # SwiGLU MLP
+    normed = common.rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(normed @ layer_params["w_gate"])
+    up = normed @ layer_params["w_up"]
+    x = x + (gate * up) @ layer_params["w_down"]
+
+    if cache_k is not None:
+        return x, (cache_k, cache_v)
+    return x, None
+
+
+def forward(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [B, S]
+    cache: Optional[KVCache] = None,
+) -> tuple[jnp.ndarray, Optional[KVCache]]:
+    """Run the decoder. Without a cache: plain causal forward (training/
+    scoring). With a cache: serving — tokens are appended at each
+    sequence's cache length (prefill S>1, decode S=1), the cache is
+    updated functionally, and logits cover the new positions.
+
+    Returns (logits [B, S, V], updated cache or None).
+    """
+    b, s = tokens.shape
+    x = params["embed"].astype(cfg.jnp_dtype)[tokens]  # [B, S, D]
+
+    if cache is not None:
+        positions = cache.length[:, None] + jnp.arange(s)[None, :]
+    else:
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+
+    layers = params["layers"]
+
+    if cache is None:
+
+        def body(x, layer_params):
+            x, _ = _layer(x, layer_params, cfg, positions, None, None, None)
+            return x, None
+
+        x, _ = jax.lax.scan(body, x, layers)
+        new_cache = None
+    else:
+
+        def body(x, scanned):
+            layer_params, ck, cv = scanned
+            x, (ck, cv) = _layer(
+                x, layer_params, cfg, positions, ck, cv, cache.length
+            )
+            return x, (ck, cv)
+
+        x, (new_k, new_v) = jax.lax.scan(body, x, (layers, cache.k, cache.v))
+        new_cache = KVCache(k=new_k, v=new_v, length=cache.length + s)
+
+    x = common.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"].astype(cfg.jnp_dtype)  # [B, S, V]
+    return logits.astype(jnp.float32), new_cache
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    d, l, v = cfg.hidden_dim, cfg.num_layers, cfg.vocab_size
+    qkv = d * (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
+    per_layer = (
+        qkv + cfg.num_heads * cfg.head_dim * d + 2 * d  # attn + norms
+        + 3 * d * cfg.ffn_dim  # mlp
+    )
+    return v * d * 2 + l * per_layer + d

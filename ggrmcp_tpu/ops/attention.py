@@ -1,0 +1,217 @@
+"""Attention ops: XLA-fused reference path and a Pallas flash-attention
+TPU kernel.
+
+Two implementations with one contract:
+
+- `attention_xla` — einsum + masked softmax. XLA fuses this well and it
+  is the correct choice for short sequences, decode steps (q_len == 1),
+  and CPU tests.
+- `flash_attention` — blockwise online-softmax Pallas kernel (the
+  standard FlashAttention recurrence) that never materializes the
+  [S, S] score matrix, keeping HBM traffic linear in sequence length.
+  Grid: (batch*heads, q_blocks); the kernel loops over k blocks with
+  running max/denominator in VMEM scratch. Causal masking skips fully
+  masked k blocks. Falls back to interpret mode off-TPU so the same
+  code path is unit-tested on the CPU mesh.
+
+`attention` picks per call: flash for long prefill on TPU, XLA
+otherwise. Shapes are [batch, seq, heads, head_dim] throughout; GQA is
+handled by repeating KV heads outside (models pass num_kv_heads).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# XLA path
+# ---------------------------------------------------------------------------
+
+
+def attention_xla(
+    q: jnp.ndarray,  # [B, Sq, H, D]
+    k: jnp.ndarray,  # [B, Sk, H, D]
+    v: jnp.ndarray,  # [B, Sk, H, D]
+    causal: bool = True,
+    q_offset: Optional[jnp.ndarray] = None,  # [B] absolute pos of q[0]
+    kv_len: Optional[jnp.ndarray] = None,  # [B] valid kv length
+) -> jnp.ndarray:
+    """Masked softmax attention; scores in float32 for stability."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    sq, sk = q.shape[1], k.shape[1]
+    mask = None
+    if causal:
+        q_pos = jnp.arange(sq)[:, None]  # [Sq, 1]
+        if q_offset is not None:
+            q_pos = q_offset[:, None, None] + q_pos[None]  # [B, Sq, 1]
+        k_pos = jnp.arange(sk)[None, :]  # [1, Sk]
+        causal_mask = q_pos >= k_pos  # [Sq, Sk] or [B, Sq, Sk]
+        mask = causal_mask if causal_mask.ndim == 3 else causal_mask[None]
+    if kv_len is not None:
+        valid = jnp.arange(sk)[None, None, :] < kv_len[:, None, None]  # [B,1,Sk]
+        mask = valid if mask is None else mask & valid
+    if mask is not None:
+        scores = jnp.where(mask[:, None, :, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(
+    q_ref,  # [block_q, D]
+    k_ref,  # [Sk, D]
+    v_ref,  # [Sk, D]
+    o_ref,  # [block_q, D]
+    *,
+    block_k: int,
+    sk: int,
+    causal: bool,
+    block_q: int,
+):
+    """One (batch*head, q_block) cell: online-softmax over k blocks."""
+    q_block_idx = pl.program_id(1)
+    q_start = q_block_idx * block_q
+
+    q = q_ref[:].astype(jnp.float32)  # [bq, D]
+    scale = q.shape[-1] ** -0.5
+    q = q * scale
+
+    m0 = jnp.full((block_q, 1), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros_like(q)
+
+    num_k_blocks = pl.cdiv(sk, block_k)
+    if causal:
+        # Last k block that can contain unmasked keys for this q block.
+        last = (q_start + block_q - 1) // block_k + 1
+        num_iters = jnp.minimum(num_k_blocks, last)
+    else:
+        num_iters = num_k_blocks
+
+    def body(kb, carry):
+        m_prev, l_prev, acc_prev = carry
+        k_start = kb * block_k
+        k_blk = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        scores = jnp.dot(
+            q, k_blk.T, preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc_prev * alpha + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jnp.ndarray,  # [B, Sq, H, D]
+    k: jnp.ndarray,  # [B, Sk, H, D]
+    v: jnp.ndarray,  # [B, Sk, H, D]
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """FlashAttention over [B, S, H, D]; S must be a multiple of the
+    block sizes (pad upstream). Runs interpreted off-TPU."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (
+        f"seq lens ({sq},{sk}) must be multiples of blocks ({block_q},{block_k})"
+    )
+
+    # [B, S, H, D] → [B*H, S, D] for a flat grid.
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, sk=sk, causal=causal, block_q=block_q
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher
+# ---------------------------------------------------------------------------
+
+# Prefill sequences at least this long go through the Pallas kernel on
+# TPU; below it the fused XLA path wins (kernel launch + padding costs).
+FLASH_MIN_SEQ = 256
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    q_offset: Optional[jnp.ndarray] = None,
+    kv_len: Optional[jnp.ndarray] = None,
+    use_flash: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Pick the right implementation for the shapes at hand."""
+    sq, sk = q.shape[1], k.shape[1]
+    if use_flash is None:
+        use_flash = (
+            jax.devices()[0].platform == "tpu"
+            and q_offset is None
+            and kv_len is None
+            and sq == sk
+            and sq >= FLASH_MIN_SEQ
+            and sq % 128 == 0
+        )
+    if use_flash:
+        return flash_attention(q, k, v, causal=causal)
+    return attention_xla(q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len)

@@ -1,0 +1,37 @@
+"""Rotary position embeddings (RoPE), functional and jit-friendly.
+
+Used by the Llama-family models. Frequencies are computed on the fly
+from static shapes (cheap, fuses into the surrounding jit) so no state
+is carried; positions are explicit so the same code serves prefill
+(positions 0..S) and decode (a single absolute position per sequence).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_freqs(head_dim: int, theta: float = 10000.0) -> jnp.ndarray:
+    """Inverse frequencies for half the head dim: [head_dim // 2]."""
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponent)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [..., seq, num_heads, head_dim]
+    positions: jnp.ndarray,  # [..., seq]
+    theta: float = 10000.0,
+) -> jnp.ndarray:
+    """Rotate pairs (x[..., :d/2], x[..., d/2:]) by position-dependent
+    angles. Computed in float32 and cast back (bf16-safe)."""
+    head_dim = x.shape[-1]
+    freqs = rope_freqs(head_dim, theta)  # [d/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., seq, d/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., seq, 1, d/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x_f32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x_f32, 2, axis=-1)
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return rotated.astype(x.dtype)

@@ -1,0 +1,97 @@
+"""Token sampling: greedy, temperature, top-k, top-p — all shapes
+static, fully jittable (no data-dependent Python control flow), so the
+decode step compiles once and stays on device.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingConfig(NamedTuple):
+    """Static sampling knobs (hashable → usable as a jit static arg)."""
+
+    temperature: float = 0.0  # 0 → greedy
+    top_k: int = 0  # 0 → disabled
+    top_p: float = 1.0  # 1 → disabled
+
+
+def sample(
+    logits: jnp.ndarray,  # [B, V]
+    key: jax.Array,
+    cfg: SamplingConfig,
+) -> jnp.ndarray:  # [B] int32
+    """Sample next tokens. Greedy when temperature == 0."""
+    if cfg.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / cfg.temperature
+    if cfg.top_k > 0:
+        logits = _mask_top_k(logits, cfg.top_k)
+    if cfg.top_p < 1.0:
+        logits = _mask_top_p(logits, cfg.top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_dynamic(
+    logits: jnp.ndarray,  # [B, V]
+    seeds: jnp.ndarray,  # [B] uint32/int — per-request seeds
+    step: jnp.ndarray,  # scalar int — decode step
+    temperature: jnp.ndarray,  # [B] float; 0 → greedy
+    top_k: jnp.ndarray,  # [B] int; 0 → disabled
+    top_p: jnp.ndarray,  # [B] float; ≥1 → disabled
+) -> jnp.ndarray:  # [B] int32
+    """Per-row sampling with *traced* parameters — the continuous-batching
+    path, where each slot carries its own sampling config and seed.
+    One full sort per row replaces static top-k/top-p masking."""
+    logits = logits.astype(jnp.float32)
+    v = logits.shape[-1]
+
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # desc
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    rank = jnp.arange(v)[None, :]
+
+    # top-k: keep ranks < k (k==0 → keep all)
+    k = jnp.where(top_k[:, None] > 0, top_k[:, None], v)
+    keep_k = rank < k
+    # top-p: keep while mass before < p (p>=1 → keep all)
+    keep_p = (cumulative - probs) < jnp.minimum(top_p, 1.0)[:, None]
+    keep = keep_k & keep_p
+    keep = keep.at[:, 0].set(True)  # always ≥ 1 token
+    # threshold = smallest kept logit per row
+    kept_count = keep.sum(axis=-1, keepdims=True)
+    threshold = jnp.take_along_axis(sorted_logits, kept_count - 1, axis=-1)
+    masked = jnp.where(logits < threshold, -jnp.inf, logits)
+
+    safe_temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = masked / safe_temp
+
+    def row_sample(seed, row_logits):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        return jax.random.categorical(key, row_logits)
+
+    sampled = jax.vmap(row_sample)(seeds, scaled).astype(jnp.int32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def _mask_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    k = min(k, logits.shape[-1])
+    threshold = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < threshold, -jnp.inf, logits)
+
+
+def _mask_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus sampling: keep the smallest prefix of the sorted
+    distribution with cumulative mass ≥ p."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    # keep tokens while the mass *before* them is < p (always ≥ 1 token)
+    keep_sorted = (cumulative - probs) < p
+    cutoff = jnp.sum(keep_sorted, axis=-1, keepdims=True)  # [B, 1]
+    threshold = jnp.take_along_axis(sorted_logits, cutoff - 1, axis=-1)
+    return jnp.where(logits < threshold, -jnp.inf, logits)

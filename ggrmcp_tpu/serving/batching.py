@@ -1,0 +1,292 @@
+"""Continuous batching for the generation engine.
+
+The throughput layer (SURVEY.md §7 stage 6): a fixed pool of decode
+slots shares one KV cache; requests are admitted into free slots via a
+single-sequence prefill whose cache rows are scattered into the shared
+cache, and every loop tick runs ONE batched decode step for all active
+slots — new requests join between ticks without stalling running ones.
+Per-slot sampling params and seeds ride as device arrays through the
+dynamic sampling path (ops/sampling.py::sample_dynamic).
+
+No reference analogue: the Go gateway proxied one RPC per call. This is
+the component that turns 64 concurrent MCP sessions into full TPU
+batches (the north-star saturation target).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from functools import partial
+from typing import AsyncIterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ggrmcp_tpu.core.config import BatchingConfig
+from ggrmcp_tpu.models import llama as llama_mod
+from ggrmcp_tpu.ops.sampling import SamplingConfig, sample_dynamic
+from ggrmcp_tpu.serving.engine import bucket_len, fit_request
+
+logger = logging.getLogger("ggrmcp.serving.batching")
+
+
+@dataclasses.dataclass
+class _Slot:
+    active: bool = False
+    request: Optional["_Request"] = None
+    generated: int = 0
+    max_new: int = 0
+    done: bool = False
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt: list[int]
+    max_new: int
+    sampling: SamplingConfig
+    seed: int
+    out: asyncio.Queue = dataclasses.field(default_factory=asyncio.Queue)
+    cancelled: bool = False
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching over a shared KV cache."""
+
+    def __init__(
+        self,
+        engine,  # GenerationEngine
+        cfg: Optional[BatchingConfig] = None,
+        eos_id: int = 2,
+    ):
+        self.engine = engine
+        self.cfg = cfg or BatchingConfig()
+        self.eos_id = eos_id
+        self.slots = [_Slot() for _ in range(self.cfg.max_batch_size)]
+        self.pending: asyncio.Queue[_Request] = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._stopping = False
+
+        b = self.cfg.max_batch_size
+        s_max = min(self.cfg.kv_cache_max_seq, engine.cfg.max_seq_len)
+        self.max_seq = s_max
+        self.cache = engine.make_cache(b, s_max)
+        # Host-mirrored per-slot state, pushed to device each tick.
+        self.cur_tokens = np.zeros((b,), np.int32)
+        self.temps = np.zeros((b,), np.float32)
+        self.top_ks = np.zeros((b,), np.int32)
+        self.top_ps = np.ones((b,), np.float32)
+        self.seeds = np.zeros((b,), np.uint32)
+        self.step_counter = 0
+
+        # jitted: one decode tick for the whole slot pool
+        self._tick = jax.jit(self._tick_impl, donate_argnums=(1,))
+        # jitted: scatter one prefilled sequence into the shared cache
+        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+        # prefill reuses the engine's jitted single-sequence path
+        self._prefill = jax.jit(
+            partial(llama_mod.forward, cfg=engine.cfg), static_argnames=()
+        )
+
+    # -- jitted bodies ------------------------------------------------------
+
+    def _tick_impl(self, tokens, cache, seeds, step, temps, ks, ps):
+        logits, cache = llama_mod.forward(
+            self.engine.params, self.engine.cfg, tokens[:, None], cache
+        )
+        nxt = sample_dynamic(logits[:, -1], seeds, step, temps, ks, ps)
+        return nxt, cache
+
+    def _insert_impl(self, cache, rows_k, rows_v, slot, length):
+        """Scatter [L,1,S,KVH,Dh] prefill rows into the shared cache at
+        `slot`, set that row's length."""
+        k = jax.lax.dynamic_update_slice(
+            cache.k, rows_k.astype(cache.k.dtype), (0, slot, 0, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            cache.v, rows_v.astype(cache.v.dtype), (0, slot, 0, 0, 0)
+        )
+        lengths = cache.length.at[slot].set(length)
+        return llama_mod.KVCache(k=k, v=v, length=lengths)
+
+    # -- public API ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None:
+            self._stopping = False
+            self._loop_ref = asyncio.get_running_loop()
+            self._task = self._loop_ref.create_task(self._loop())
+
+    async def stop(self) -> None:
+        self._stopping = True
+        self._wake.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def submit(
+        self,
+        prompt: list[int],
+        max_new: int,
+        sampling: SamplingConfig,
+        seed: int = 0,
+    ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
+        """Enqueue a request; yields (token_ids_chunk, finish_reason)
+        pairs; finish_reason is set on the final chunk."""
+        prompt, max_new = fit_request(prompt, max_new, self.max_seq)
+        request = _Request(
+            prompt=prompt, max_new=max_new, sampling=sampling, seed=seed
+        )
+        await self.pending.put(request)
+        self._wake.set()
+        try:
+            while True:
+                ids, reason = await request.out.get()
+                yield ids, reason
+                if reason is not None:
+                    return
+        finally:
+            request.cancelled = True
+
+    # -- the loop -----------------------------------------------------------
+
+    def _free_slots(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots) if not s.active]
+
+    def _active_count(self) -> int:
+        return sum(s.active for s in self.slots)
+
+    async def _loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._stopping:
+            admitted = await self._admit()
+            if self._active_count() == 0:
+                # Clear BEFORE checking pending: a submit() landing after
+                # the check still leaves its set() visible to wait(),
+                # avoiding the lost-wakeup race.
+                self._wake.clear()
+                if not self.pending.empty():
+                    continue
+                await self._wake.wait()
+                continue
+            # One batched decode tick (device-bound → executor).
+            await loop.run_in_executor(None, self._tick_sync)
+            await asyncio.sleep(0)  # let handlers drain queues
+
+    async def _admit(self) -> int:
+        """Admit pending requests into free slots, prefilling each."""
+        admitted = 0
+        deadline = time.monotonic() + self.cfg.max_queue_delay_ms / 1000.0
+        loop = asyncio.get_running_loop()
+        while self._free_slots():
+            try:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0 or admitted >= len(self.slots):
+                    break
+                if self._active_count() > 0 or admitted > 0:
+                    # Don't stall running decodes waiting for stragglers.
+                    request = self.pending.get_nowait()
+                else:
+                    request = await asyncio.wait_for(
+                        self.pending.get(), timeout=timeout
+                    )
+            except (asyncio.TimeoutError, asyncio.QueueEmpty):
+                break
+            if request.cancelled:
+                continue
+            slot_idx = self._free_slots()[0]
+            await loop.run_in_executor(
+                None, self._prefill_into_slot, slot_idx, request
+            )
+            admitted += 1
+        return admitted
+
+    def _prefill_into_slot(self, slot_idx: int, request: _Request) -> None:
+        prompt = request.prompt
+        s = bucket_len(len(prompt), maximum=self.max_seq)
+        tokens = np.zeros((1, s), np.int32)
+        tokens[0, : len(prompt)] = prompt
+        # Single-sequence prefill producing this row's cache prefix.
+        mini_cache = llama_mod.KVCache.create(self.engine.cfg, 1, s)
+        logits, mini_cache = self._prefill(
+            self.engine.params, tokens=jnp.asarray(tokens), cache=mini_cache
+        )
+        first = sample_dynamic(
+            logits[:, len(prompt) - 1],
+            jnp.asarray([request.seed], jnp.uint32),
+            jnp.int32(0),
+            jnp.asarray([request.sampling.temperature], jnp.float32),
+            jnp.asarray([request.sampling.top_k], jnp.int32),
+            jnp.asarray([request.sampling.top_p], jnp.float32),
+        )
+        first_tok = int(first[0])
+        # Pad prefill rows to the shared cache length on the host side
+        # is unnecessary: dynamic_update_slice handles smaller blocks.
+        self.cache = self._insert(
+            self.cache, mini_cache.k, mini_cache.v,
+            jnp.int32(slot_idx), jnp.int32(len(prompt)),
+        )
+        slot = self.slots[slot_idx]
+        slot.active = True
+        slot.request = request
+        slot.generated = 0
+        slot.max_new = request.max_new
+        slot.done = False
+        self.cur_tokens[slot_idx] = first_tok
+        self.temps[slot_idx] = request.sampling.temperature
+        self.top_ks[slot_idx] = request.sampling.top_k
+        self.top_ps[slot_idx] = request.sampling.top_p
+        self.seeds[slot_idx] = request.seed & 0xFFFFFFFF
+        self._emit(slot_idx, first_tok)
+
+    def _tick_sync(self) -> None:
+        self.step_counter += 1
+        nxt, self.cache = self._tick(
+            jnp.asarray(self.cur_tokens), self.cache,
+            jnp.asarray(self.seeds), jnp.int32(self.step_counter),
+            jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+            jnp.asarray(self.top_ps),
+        )
+        nxt = np.asarray(nxt)
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            self.cur_tokens[i] = nxt[i]
+            self._emit(i, int(nxt[i]))
+
+    def _emit(self, slot_idx: int, token: int) -> None:
+        slot = self.slots[slot_idx]
+        request = slot.request
+        if request is None:
+            return
+        finished_reason = None
+        if token == self.eos_id:
+            finished_reason = "stop"
+            ids: list[int] = []
+        else:
+            slot.generated += 1
+            ids = [token]
+            if slot.generated >= slot.max_new:
+                finished_reason = "length"
+        if request.cancelled:
+            finished_reason = finished_reason or "cancelled"
+            ids = []
+        # _emit runs on executor threads; asyncio.Queue is not
+        # thread-safe, so hop through the loop.
+        self._loop_ref.call_soon_threadsafe(
+            request.out.put_nowait, (ids, finished_reason)
+        )
+        if finished_reason is not None:
+            slot.active = False
+            slot.request = None
+            # Park the slot: freeze its row so it stops influencing
+            # shared state (cache row stays, masked by length on reuse).
+            self.temps[slot_idx] = 0.0

@@ -1,0 +1,50 @@
+"""Checkpoint save/restore for model parameters and train state.
+
+The reference had no persistence at all (SURVEY.md §5.4); in the TPU
+build, checkpointing is model-weight lifecycle: Orbax-backed save and
+(sharding-aware) restore, so sidecars can load real weights instead of
+random init, and training can resume.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+
+logger = logging.getLogger("ggrmcp.serving.checkpoint")
+
+
+def save(path: str, params: Any) -> None:
+    """Save a param pytree with Orbax (atomic, async-capable)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, params, force=True)
+    logger.info("saved checkpoint to %s", path)
+
+
+def restore(path: str, like: Any = None, shardings: Any = None) -> Any:
+    """Restore a param pytree. If `like` (an abstract or concrete pytree)
+    is given, shapes/dtypes are validated and arrays land with its
+    shardings; with `shardings`, arrays are placed directly onto the
+    mesh during restore (no host round-trip)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is not None:
+            target = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype,
+                    sharding=getattr(x, "sharding", None),
+                ),
+                like,
+            )
+            return ckptr.restore(path, target)
+        if shardings is not None:
+            return ckptr.restore(path, shardings)
+        return ckptr.restore(path)

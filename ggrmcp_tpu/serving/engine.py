@@ -1,0 +1,387 @@
+"""Model engines: jitted, sharded prefill/decode/embed with shape
+bucketing.
+
+The execution core of the serving plane (SURVEY.md §7 stages 4-5):
+
+- Parameters live on the mesh (`NamedSharding` from the model's
+  param_specs); every step is a `jax.jit` with donated KV cache, so
+  decode is one XLA program per (batch, bucket) shape with no host
+  round-trips inside.
+- Prefill handles right-padded variable-length batches: positions are
+  causal from 0, per-row true lengths gate the KV mask, last-token
+  logits are gathered per row, and the cache length is set to the true
+  length so decode overwrites pad slots.
+- Full-sequence generation is a single fused `lax.scan` over decode
+  steps (compile once, stay on device); streaming uses the per-step
+  jit and yields tokens as they materialize.
+- Shape bucketing (powers of two) bounds the number of compilations.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from functools import partial
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from ggrmcp_tpu.core.config import ServingConfig
+from ggrmcp_tpu.models import bert as bert_mod
+from ggrmcp_tpu.models import llama as llama_mod
+from ggrmcp_tpu.models.common import count_params
+from ggrmcp_tpu.ops.sampling import SamplingConfig, sample
+from ggrmcp_tpu.parallel import mesh as mesh_mod
+from ggrmcp_tpu.utils.jaxenv import apply_platform_env
+
+logger = logging.getLogger("ggrmcp.serving.engine")
+
+# Engines are the first jax consumers in every entry path; make the
+# operator's JAX_PLATFORMS env var authoritative before any backend
+# initializes (see utils/jaxenv.py).
+apply_platform_env()
+
+
+def bucket_len(n: int, minimum: int = 32, maximum: int = 1 << 20) -> int:
+    """Round up to a power of two within [minimum, maximum]."""
+    return min(max(minimum, 1 << max(0, math.ceil(math.log2(max(n, 1))))), maximum)
+
+
+def fit_request(
+    prompt: list[int], max_new: int, limit: int
+) -> tuple[list[int], int]:
+    """Clamp (prompt, max_new) so prompt + generation + 1 fits in a
+    `limit`-length KV cache: keeps the prompt tail, then caps max_new.
+    Prevents silent out-of-bounds cache writes (dropped inside jit)."""
+    if len(prompt) + max_new + 1 > limit:
+        keep = max(1, limit - max_new - 1)
+        prompt = prompt[-keep:]
+        max_new = max(1, min(max_new, limit - len(prompt) - 1))
+    return prompt, max_new
+
+
+def _adapt_specs(specs, shapes, mesh: Mesh):
+    """Null out spec axes that don't divide the actual dims (vocab sizes
+    and tiny test models aren't always multiples of the mesh)."""
+    return jax.tree_util.tree_map(
+        lambda s, x: mesh_mod.compatible_spec(s, x.shape, mesh), specs, shapes
+    )
+
+
+def _shard_params(params, specs, mesh: Mesh):
+    specs = _adapt_specs(specs, params, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def _sharded_init(init_fn, specs, mesh: Mesh, key):
+    """jit the initializer with mesh-adapted output shardings."""
+    shapes = jax.eval_shape(init_fn, key)
+    specs = _adapt_specs(specs, shapes, mesh)
+    with mesh:
+        params = jax.jit(
+            init_fn,
+            out_shardings=jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs
+            ),
+        )(key)
+    jax.block_until_ready(params)
+    return params
+
+
+class GenerationEngine:
+    """Llama-family generation: prefill + decode + fused generate."""
+
+    def __init__(
+        self,
+        cfg: llama_mod.LlamaConfig,
+        serving: Optional[ServingConfig] = None,
+        mesh: Optional[Mesh] = None,
+        params=None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.serving = serving or ServingConfig()
+        self.mesh = mesh if mesh is not None else mesh_mod.build_mesh(
+            self.serving.mesh
+        )
+        if params is None:
+            t0 = time.monotonic()
+            params = _sharded_init(
+                partial(llama_mod.init_params, cfg=cfg),
+                llama_mod.param_specs(cfg), self.mesh,
+                jax.random.PRNGKey(seed),
+            )
+            logger.info(
+                "initialized %s: %.1fM params in %.1fs",
+                cfg.name, count_params(params) / 1e6, time.monotonic() - t0,
+            )
+        else:
+            params = _shard_params(params, llama_mod.param_specs(cfg), self.mesh)
+        self.params = params
+        self._prefill_fn = jax.jit(
+            self._prefill_impl, donate_argnums=(2,), static_argnums=()
+        )
+        self._decode_fn = jax.jit(
+            self._decode_impl, donate_argnums=(1,), static_argnums=(4,)
+        )
+        # bound method: args are (tokens, true_len, max_new, sampling,
+        # rng, eos_id) — max_new and sampling are static.
+        self._generate_fn = jax.jit(
+            self._generate_impl, static_argnums=(2, 3)
+        )
+
+    # -- jitted bodies ------------------------------------------------------
+
+    def _prefill_impl(self, tokens, true_len, cache):
+        """tokens [B,S] right-padded; true_len [B]. Returns
+        (last_logits [B,V], cache with length=true_len)."""
+        logits, cache = llama_mod.forward(self.params, self.cfg, tokens, cache)
+        idx = jnp.maximum(true_len - 1, 0)
+        last = jnp.take_along_axis(
+            logits, idx[:, None, None], axis=1
+        )[:, 0]  # [B, V]
+        cache = cache._replace(length=true_len)
+        return last, cache
+
+    def _decode_impl(self, tokens, cache, rng, step, sampling: SamplingConfig):
+        """tokens [B,1] → (next [B], cache)."""
+        logits, cache = llama_mod.forward(self.params, self.cfg, tokens, cache)
+        key = jax.random.fold_in(rng, step)
+        next_tok = sample(logits[:, -1], key, sampling)
+        return next_tok, cache
+
+    def _generate_impl(
+        self, tokens, true_len, max_new: int, sampling: SamplingConfig, rng,
+        eos_id,
+    ):
+        """Fused prefill + scan-decode. Returns (out_tokens [B, max_new],
+        out_len [B])."""
+        b = tokens.shape[0]
+        max_cache = tokens.shape[1] + max_new
+        cache = llama_mod.KVCache.create(self.cfg, b, max_cache)
+        last_logits, cache = self._prefill_impl(tokens, true_len, cache)
+        key0 = jax.random.fold_in(rng, 0)
+        first = sample(last_logits, key0, sampling)  # [B]
+        done0 = first == eos_id
+
+        def step(carry, i):
+            cur, cache, done = carry
+            logits, cache = llama_mod.forward(
+                self.params, self.cfg, cur[:, None], cache
+            )
+            key = jax.random.fold_in(rng, i + 1)
+            nxt = sample(logits[:, -1], key, sampling)
+            nxt = jnp.where(done, eos_id, nxt)
+            new_done = done | (nxt == eos_id)
+            return (nxt, cache, new_done), nxt
+
+        (_, _, done), rest = jax.lax.scan(
+            step, (first, cache, done0), jnp.arange(max_new - 1)
+        )
+        out = jnp.concatenate([first[:, None], rest.T], axis=1)  # [B, max_new]
+        # out_len = tokens up to and including first eos (or max_new)
+        is_eos = out == eos_id
+        any_eos = is_eos.any(axis=1)
+        first_eos = jnp.argmax(is_eos, axis=1)
+        out_len = jnp.where(any_eos, first_eos + 1, max_new)
+        return out, out_len
+
+    # -- public API ---------------------------------------------------------
+
+    def make_cache(self, batch: int, max_len: int) -> llama_mod.KVCache:
+        kv_shape = (
+            self.cfg.num_layers, batch, max_len,
+            self.cfg.num_kv_heads, self.cfg.head_dim,
+        )
+        specs = llama_mod.cache_specs()
+        specs = llama_mod.KVCache(
+            k=mesh_mod.compatible_spec(specs.k, kv_shape, self.mesh),
+            v=mesh_mod.compatible_spec(specs.v, kv_shape, self.mesh),
+            length=mesh_mod.compatible_spec(specs.length, (batch,), self.mesh),
+        )
+        with self.mesh:
+            return jax.jit(
+                partial(llama_mod.KVCache.create, self.cfg, batch, max_len),
+                out_shardings=jax.tree_util.tree_map(
+                    lambda s: NamedSharding(self.mesh, s), specs,
+                ),
+            )()
+
+    def generate(
+        self,
+        prompts: list[list[int]],
+        max_new_tokens: int = 128,
+        sampling: SamplingConfig = SamplingConfig(),
+        eos_id: int = 2,
+        seed: int = 0,
+    ) -> tuple[list[list[int]], list[str]]:
+        """Batch generation via the fused path. Returns (token lists,
+        finish reasons)."""
+        fitted = [
+            fit_request(p, max_new_tokens, self.cfg.max_seq_len) for p in prompts
+        ]
+        prompts = [p for p, _ in fitted]
+        max_new_tokens = min(m for _, m in fitted)
+        b = len(prompts)
+        max_prompt = max(len(p) for p in prompts)
+        s = bucket_len(max_prompt, maximum=self.cfg.max_seq_len)
+        tokens = np.zeros((b, s), dtype=np.int32)
+        true_len = np.zeros((b,), dtype=np.int32)
+        for i, p in enumerate(prompts):
+            tokens[i, : len(p)] = p
+            true_len[i] = len(p)
+        with self.mesh:
+            out, out_len = self._generate_fn(
+                jnp.asarray(tokens), jnp.asarray(true_len),
+                max_new_tokens, sampling,
+                jax.random.PRNGKey(seed), jnp.int32(eos_id),
+            )
+        out = np.asarray(out)
+        out_len = np.asarray(out_len)
+        results, reasons = [], []
+        for i in range(b):
+            ids = out[i, : out_len[i]].tolist()
+            if ids and ids[-1] == eos_id:
+                ids = ids[:-1]
+                reasons.append("stop")
+            else:
+                reasons.append("length")
+            results.append(ids)
+        return results, reasons
+
+    def generate_stream(
+        self,
+        prompt: list[int],
+        max_new_tokens: int = 128,
+        sampling: SamplingConfig = SamplingConfig(),
+        eos_id: int = 2,
+        seed: int = 0,
+    ) -> Iterator[int]:
+        """Single-sequence streaming: per-step jitted decode, yields
+        token ids as they are sampled."""
+        prompt, max_new_tokens = fit_request(
+            prompt, max_new_tokens, self.cfg.max_seq_len
+        )
+        s = bucket_len(len(prompt), maximum=self.cfg.max_seq_len)
+        tokens = np.zeros((1, s), dtype=np.int32)
+        tokens[0, : len(prompt)] = prompt
+        true_len = np.array([len(prompt)], dtype=np.int32)
+        max_cache = bucket_len(len(prompt) + max_new_tokens + 1,
+                               maximum=self.cfg.max_seq_len)
+        rng = jax.random.PRNGKey(seed)
+        with self.mesh:
+            cache = self.make_cache(1, max_cache)
+            last_logits, cache = self._prefill_fn(
+                jnp.asarray(tokens), jnp.asarray(true_len), cache
+            )
+            cur = sample(last_logits, jax.random.fold_in(rng, 0),
+                         sampling)
+            for i in range(max_new_tokens):
+                tok = int(cur[0])
+                if tok == eos_id:
+                    return
+                yield tok
+                if i == max_new_tokens - 1:
+                    return
+                cur, cache = self._decode_fn(
+                    cur[:, None], cache, rng, i + 1, sampling
+                )
+
+    def model_info(self) -> dict:
+        return _model_info(self, "llama")
+
+
+class EmbeddingEngine:
+    """BERT-family embeddings: jitted, bucketed batch embed."""
+
+    def __init__(
+        self,
+        cfg: bert_mod.BertConfig,
+        serving: Optional[ServingConfig] = None,
+        mesh: Optional[Mesh] = None,
+        params=None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.serving = serving or ServingConfig()
+        self.mesh = mesh if mesh is not None else mesh_mod.build_mesh(
+            self.serving.mesh
+        )
+        if params is None:
+            params = _sharded_init(
+                partial(bert_mod.init_params, cfg=cfg),
+                bert_mod.param_specs(cfg), self.mesh,
+                jax.random.PRNGKey(seed),
+            )
+            logger.info(
+                "initialized %s: %.1fM params",
+                cfg.name, count_params(params) / 1e6,
+            )
+        else:
+            params = _shard_params(params, bert_mod.param_specs(cfg), self.mesh)
+        self.params = params
+        self._embed_fn = jax.jit(self._embed_impl, static_argnums=(2,))
+
+    def _embed_impl(self, tokens, mask, pooling: str):
+        return bert_mod.embed(self.params, self.cfg, tokens, mask, pooling)
+
+    MAX_CHUNK = 4096
+
+    def embed(
+        self,
+        token_lists: list[list[int]],
+        pooling: str = "mean",
+        max_length: int = 0,
+    ) -> np.ndarray:
+        """Embed a batch of token lists; batches beyond MAX_CHUNK rows
+        are processed in chunks and concatenated."""
+        if len(token_lists) > self.MAX_CHUNK:
+            parts = [
+                self._embed_chunk(
+                    token_lists[i : i + self.MAX_CHUNK], pooling, max_length
+                )
+                for i in range(0, len(token_lists), self.MAX_CHUNK)
+            ]
+            return np.concatenate(parts, axis=0)
+        return self._embed_chunk(token_lists, pooling, max_length)
+
+    def _embed_chunk(
+        self, token_lists: list[list[int]], pooling: str, max_length: int
+    ) -> np.ndarray:
+        limit = max_length or self.cfg.max_seq_len
+        b = len(token_lists)
+        longest = min(max(len(t) for t in token_lists), limit)
+        s = bucket_len(longest, maximum=self.cfg.max_seq_len)
+        bb = bucket_len(b, minimum=1, maximum=self.MAX_CHUNK)
+        tokens = np.zeros((bb, s), dtype=np.int32)
+        mask = np.zeros((bb, s), dtype=np.int32)
+        for i, ids in enumerate(token_lists):
+            ids = ids[:limit]
+            tokens[i, : len(ids)] = ids
+            mask[i, : len(ids)] = 1
+        with self.mesh:
+            out = self._embed_fn(jnp.asarray(tokens), jnp.asarray(mask), pooling)
+        return np.asarray(out)[:b]
+
+    def model_info(self) -> dict:
+        return _model_info(self, "bert")
+
+
+def _model_info(engine, family: str) -> dict:
+    sizes = dict(zip(engine.mesh.axis_names, engine.mesh.devices.shape))
+    return {
+        "model_id": engine.cfg.name,
+        "family": family,
+        "num_params_million": int(count_params(engine.params) / 1e6),
+        "max_seq_len": engine.cfg.max_seq_len,
+        "dtype": engine.cfg.dtype,
+        "mesh": {k: v for k, v in sizes.items() if v > 1},
+        "num_devices": int(engine.mesh.devices.size),
+        "platform": engine.mesh.devices.flat[0].platform,
+    }

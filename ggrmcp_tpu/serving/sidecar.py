@@ -1,0 +1,340 @@
+"""The TPU serving sidecar: a gRPC server exposing JAX model engines.
+
+The model plane's front door (SURVEY.md §7 stage 4, BASELINE.json north
+star): EmbedService / GenerateService / ModelInfoService plus standard
+reflection and health — so the gateway discovers a TPU model exactly
+like any gRPC backend, while the implementations dispatch into jitted,
+mesh-sharded engines. Server-streaming GenerateStream feeds the
+gateway's MCP streaming path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+import grpc
+import grpc.aio
+import numpy as np
+
+from ggrmcp_tpu.core.config import Config, ServingConfig
+from ggrmcp_tpu.models import get_model
+from ggrmcp_tpu.ops.sampling import SamplingConfig
+from ggrmcp_tpu.rpc.pb import serving_pb2
+from ggrmcp_tpu.rpc.server_utils import (
+    HealthService,
+    MethodDef,
+    ReflectionService,
+    add_service,
+)
+from ggrmcp_tpu.serving import tensors
+from ggrmcp_tpu.serving.batching import ContinuousBatcher
+from ggrmcp_tpu.serving.engine import EmbeddingEngine, GenerationEngine
+from ggrmcp_tpu.serving.tokenizer import load_tokenizer
+
+logger = logging.getLogger("ggrmcp.serving.sidecar")
+
+SERVICES = [
+    "ggrmcp.tpu.EmbedService",
+    "ggrmcp.tpu.GenerateService",
+    "ggrmcp.tpu.ModelInfoService",
+]
+
+
+class Sidecar:
+    """Owns the engines and the grpc.aio server."""
+
+    def __init__(self, serving: Optional[ServingConfig] = None, mesh=None):
+        self.serving = serving or ServingConfig()
+        self.tokenizer = load_tokenizer(self.serving.tokenizer_path)
+        family, model_cfg = get_model(self.serving.model)
+        self.family = family
+        self.generation: Optional[GenerationEngine] = None
+        self.embedding: Optional[EmbeddingEngine] = None
+        self.batcher: Optional[ContinuousBatcher] = None
+        params = None
+        if self.serving.checkpoint_path:
+            from ggrmcp_tpu.serving.checkpoint import restore
+
+            params = restore(self.serving.checkpoint_path)
+            logger.info(
+                "restored params from %s", self.serving.checkpoint_path
+            )
+        if family == "llama":
+            self.generation = GenerationEngine(
+                model_cfg, self.serving, mesh=mesh, params=params
+            )
+            self.batcher = ContinuousBatcher(
+                self.generation, self.serving.batching,
+                eos_id=self.tokenizer.eos_id,
+            )
+        else:
+            self.embedding = EmbeddingEngine(
+                model_cfg, self.serving, mesh=mesh, params=params
+            )
+        self.server: Optional[grpc.aio.Server] = None
+        self.health = HealthService()
+        self.port = 0
+
+    # ------------------------------------------------------------------
+    # EmbedService
+    # ------------------------------------------------------------------
+
+    async def embed(self, request: serving_pb2.EmbedRequest, context):
+        if self.embedding is None:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"model {self.serving.model} does not serve embeddings",
+            )
+        t0 = time.perf_counter()
+        has_token_ids = (
+            request.token_ids.shape
+            or request.token_ids.int_values
+            or request.token_ids.data
+        )
+        if has_token_ids:
+            ids = tensors.from_proto(request.token_ids).astype(np.int32)
+            token_lists = [
+                _strip_trailing_pads(row) for row in np.atleast_2d(ids)
+            ]
+        elif request.texts:
+            token_lists = [self.tokenizer.encode(t) for t in request.texts]
+        else:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "texts or token_ids required"
+            )
+        token_lists = [t or [self.tokenizer.pad_id] for t in token_lists]
+        pooling = request.pooling or "mean"
+        if pooling not in ("mean", "cls", "max"):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"unknown pooling {pooling!r}",
+            )
+        loop = asyncio.get_running_loop()
+        vectors = await loop.run_in_executor(
+            None,
+            lambda: self.embedding.embed(
+                token_lists, pooling, request.max_length
+            ),
+        )
+        return serving_pb2.EmbedResponse(
+            embeddings=tensors.to_proto(vectors),
+            model_id=self.embedding.cfg.name,
+            compute_ms=(time.perf_counter() - t0) * 1000,
+        )
+
+    # ------------------------------------------------------------------
+    # GenerateService
+    # ------------------------------------------------------------------
+
+    def _prompt_ids(self, request: serving_pb2.GenerateRequest) -> list[int]:
+        if request.prompt_ids.shape or request.prompt_ids.int_values:
+            return (
+                tensors.from_proto(request.prompt_ids)
+                .astype(np.int32).reshape(-1).tolist()
+            )
+        if request.prompt:
+            return [self.tokenizer.bos_id] + self.tokenizer.encode(request.prompt)
+        return [self.tokenizer.bos_id]
+
+    def _sampling(self, request: serving_pb2.GenerateRequest) -> SamplingConfig:
+        s = request.sampling
+        return SamplingConfig(
+            temperature=s.temperature,
+            top_k=s.top_k,
+            top_p=s.top_p if 0.0 < s.top_p < 1.0 else 1.0,
+        )
+
+    async def generate(self, request: serving_pb2.GenerateRequest, context):
+        if self.generation is None or self.batcher is None:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"model {self.serving.model} does not serve generation",
+            )
+        t0 = time.perf_counter()
+        prompt = self._prompt_ids(request)
+        max_new = request.max_new_tokens or 64
+        max_new = min(max_new, self.serving.batching.max_decode_steps)
+        seed = request.sampling.seed or 0
+        token_ids: list[int] = []
+        finish = "length"
+        async for chunk_ids, reason in self.batcher.submit(
+            prompt, max_new, self._sampling(request), seed
+        ):
+            token_ids.extend(chunk_ids)
+            if reason:
+                finish = reason
+        text = self.tokenizer.decode(token_ids)
+        text, finish = _apply_stops(text, list(request.stop), finish)
+        return serving_pb2.GenerateResponse(
+            text=text,
+            token_ids=token_ids if request.return_tokens else [],
+            finish_reason=finish,
+            prompt_tokens=len(prompt),
+            completion_tokens=len(token_ids),
+            model_id=self.generation.cfg.name,
+            compute_ms=(time.perf_counter() - t0) * 1000,
+        )
+
+    async def generate_stream(self, request: serving_pb2.GenerateRequest, context):
+        if self.generation is None or self.batcher is None:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"model {self.serving.model} does not serve generation",
+            )
+        prompt = self._prompt_ids(request)
+        max_new = min(
+            request.max_new_tokens or 64, self.serving.batching.max_decode_steps
+        )
+        seed = request.sampling.seed or 0
+        emitted = ""
+        stops = list(request.stop)
+        all_ids: list[int] = []
+
+        def delta_for(final: bool) -> tuple[str, str]:
+            """(delta, stop_hit): emit only the stable prefix while
+            streaming (incomplete multi-byte UTF-8 is held back until
+            the sequence completes); flush everything on the final
+            chunk."""
+            text = self.tokenizer.decode(all_ids)
+            stopped_text, stop_hit = _apply_stops(text, stops, "")
+            stable = stopped_text if final else _stable_prefix(stopped_text)
+            if len(stable) < len(emitted):
+                return "", stop_hit  # stop cut before emitted point
+            return stable[len(emitted):], stop_hit
+
+        async for chunk_ids, reason in self.batcher.submit(
+            prompt, max_new, self._sampling(request), seed
+        ):
+            all_ids.extend(chunk_ids)
+            final = reason is not None
+            delta, stop_hit = delta_for(final)
+            if delta:
+                emitted += delta
+                yield serving_pb2.GenerateChunk(
+                    text_delta=delta,
+                    token_ids=chunk_ids if request.return_tokens else [],
+                )
+            if stop_hit == "stop_string":
+                yield serving_pb2.GenerateChunk(
+                    finish_reason="stop_string", done=True
+                )
+                return
+            if reason:
+                yield serving_pb2.GenerateChunk(finish_reason=reason, done=True)
+                return
+        yield serving_pb2.GenerateChunk(finish_reason="length", done=True)
+
+    # ------------------------------------------------------------------
+    # ModelInfoService
+    # ------------------------------------------------------------------
+
+    async def get_model_info(self, request, context):
+        engine = self.generation or self.embedding
+        info = engine.model_info()
+        return serving_pb2.ModelInfoResponse(
+            model_id=info["model_id"],
+            family=info["family"],
+            num_params_million=info["num_params_million"],
+            max_seq_len=info["max_seq_len"],
+            dtype=info["dtype"],
+            mesh=info["mesh"],
+            num_devices=info["num_devices"],
+            platform=info["platform"],
+        )
+
+    # ------------------------------------------------------------------
+    # Server lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self, port: Optional[int] = None) -> int:
+        self.server = grpc.aio.server()
+        add_service(
+            self.server, "ggrmcp.tpu.EmbedService",
+            {"Embed": MethodDef(
+                self.embed, serving_pb2.EmbedRequest, serving_pb2.EmbedResponse
+            )},
+        )
+        add_service(
+            self.server, "ggrmcp.tpu.GenerateService",
+            {
+                "Generate": MethodDef(
+                    self.generate,
+                    serving_pb2.GenerateRequest, serving_pb2.GenerateResponse,
+                ),
+                "GenerateStream": MethodDef(
+                    self.generate_stream,
+                    serving_pb2.GenerateRequest, serving_pb2.GenerateChunk,
+                    server_streaming=True,
+                ),
+            },
+        )
+        add_service(
+            self.server, "ggrmcp.tpu.ModelInfoService",
+            {"GetModelInfo": MethodDef(
+                self.get_model_info,
+                serving_pb2.ModelInfoRequest, serving_pb2.ModelInfoResponse,
+            )},
+        )
+        ReflectionService(SERVICES).attach(self.server)
+        self.health.attach(self.server)
+        bind = port if port is not None else self.serving.port
+        self.port = self.server.add_insecure_port(f"0.0.0.0:{bind}")
+        if self.batcher is not None:
+            self.batcher.start()
+        await self.server.start()
+        logger.info(
+            "sidecar serving %s (%s) on :%d",
+            self.serving.model, self.family, self.port,
+        )
+        return self.port
+
+    async def stop(self) -> None:
+        if self.batcher is not None:
+            await self.batcher.stop()
+        if self.server is not None:
+            await self.server.stop(grace=2.0)
+
+
+def _strip_trailing_pads(row: "np.ndarray") -> list[int]:
+    """Strip only TRAILING zeros (padding); interior zeros are real ids."""
+    nonzero = np.nonzero(row)[0]
+    if len(nonzero) == 0:
+        return []
+    return row[: nonzero[-1] + 1].tolist()
+
+
+def _stable_prefix(text: str) -> str:
+    """Hold back a trailing replacement char: it usually marks a
+    partially-decoded multi-byte UTF-8 sequence that later tokens will
+    complete — emitting it would corrupt the stream irreversibly."""
+    return text.rstrip("�")
+
+
+def _apply_stops(text: str, stops: list[str], finish: str) -> tuple[str, str]:
+    """Truncate at the earliest stop string, if any."""
+    cut = -1
+    for stop in stops:
+        if not stop:
+            continue
+        idx = text.find(stop)
+        if idx >= 0 and (cut < 0 or idx < cut):
+            cut = idx
+    if cut >= 0:
+        return text[:cut], "stop_string"
+    return text, finish
+
+
+def run(cfg: Config) -> None:
+    from ggrmcp_tpu.gateway.app import setup_logging
+
+    setup_logging(cfg)
+
+    async def main():
+        sidecar = Sidecar(cfg.serving)
+        await sidecar.start()
+        await sidecar.server.wait_for_termination()
+
+    asyncio.run(main())

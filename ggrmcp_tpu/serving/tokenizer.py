@@ -1,0 +1,75 @@
+"""Tokenizers for the serving plane.
+
+Default is a hermetic byte-level tokenizer (UTF-8 bytes + specials) so
+the stack runs with zero downloaded assets — this environment has no
+egress. When a HuggingFace `tokenizer.json` is available on disk, the
+`tokenizers` library is used instead (same interface).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Protocol
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    bos_id: int
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """pad=0, bos=1, eos=2; byte b ↦ b + 3. Lossless for any UTF-8."""
+
+    OFFSET = 3
+
+    def __init__(self) -> None:
+        self.vocab_size = 256 + self.OFFSET
+        self.pad_id = 0
+        self.bos_id = 1
+        self.eos_id = 2
+
+    def encode(self, text: str) -> list[int]:
+        return [b + self.OFFSET for b in text.encode("utf-8")]
+
+    def decode(self, ids: list[int]) -> str:
+        data = bytes(
+            i - self.OFFSET for i in ids if i >= self.OFFSET and i - self.OFFSET < 256
+        )
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Wrapper over a local tokenizers-library file."""
+
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer as _Tok
+
+        self._tok = _Tok.from_file(path)
+        self.vocab_size = self._tok.get_vocab_size()
+        self.pad_id = self._token_id(["<pad>", "[PAD]"], 0)
+        self.bos_id = self._token_id(["<s>", "<|begin_of_text|>", "[CLS]"], 1)
+        self.eos_id = self._token_id(["</s>", "<|end_of_text|>", "[SEP]"], 2)
+
+    def _token_id(self, candidates: list[str], default: int) -> int:
+        for cand in candidates:
+            tid = self._tok.token_to_id(cand)
+            if tid is not None:
+                return tid
+        return default
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def load_tokenizer(path: str = "") -> Tokenizer:
+    if path and os.path.exists(path):
+        return HFTokenizer(path)
+    return ByteTokenizer()

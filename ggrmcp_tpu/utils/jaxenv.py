@@ -1,0 +1,28 @@
+"""JAX platform selection that honors the JAX_PLATFORMS env var.
+
+Some environments (e.g. the axon TPU tunnel) register a PJRT plugin at
+interpreter startup and call jax.config.update("jax_platforms", ...),
+silently overriding the user's JAX_PLATFORMS env var. Framework entry
+points call `apply_platform_env()` right after importing jax so an
+operator's `JAX_PLATFORMS=cpu python -m ggrmcp_tpu sidecar` means what
+it says.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger("ggrmcp.utils.jaxenv")
+
+
+def apply_platform_env() -> None:
+    env = os.environ.get("JAX_PLATFORMS")
+    if not env:
+        return
+    import jax
+
+    current = jax.config.jax_platforms
+    if current != env:
+        logger.info("re-applying JAX_PLATFORMS=%s (config had %r)", env, current)
+        jax.config.update("jax_platforms", env)

@@ -7,9 +7,12 @@ story): the env vars must be set before jax is first imported anywhere.
 import os
 import sys
 
-# Force CPU: the environment pins JAX_PLATFORMS=axon (the real TPU via a
-# tunnel) which is slow to claim and single-chip; tests run on a virtual
-# 8-device CPU mesh instead. bench.py keeps the real TPU platform.
+# Force CPU: the environment pins the 'axon' platform (the real TPU via
+# a tunnel) which is slow to claim and single-chip; tests run on a
+# virtual 8-device CPU mesh instead. bench.py keeps the real TPU
+# platform. The axon sitecustomize calls
+# jax.config.update("jax_platforms", "axon,cpu") at interpreter start,
+# so the env var alone is not enough — the config must be re-set.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -18,6 +21,13 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover
+    pass
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
