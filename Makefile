@@ -1,0 +1,37 @@
+# ggrmcp-tpu build/test entry points (reference Makefile parity:
+# proto generation, tests, fixtures — adapted to the Python/JAX stack).
+
+PROTOC ?= protoc
+PY ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: proto descriptors test test-fast bench-cpu smoke clean
+
+# Regenerate pb2 modules from protos/ (committed; rerun after editing).
+proto:
+	$(PROTOC) -Iprotos --python_out=ggrmcp_tpu/rpc/pb protos/*.proto
+
+# Test fixtures: FileDescriptorSets with source info (comment extraction).
+descriptors:
+	$(PROTOC) -Iprotos --descriptor_set_out=tests/testdata/complex.binpb \
+	  --include_source_info --include_imports protos/complex.proto
+	$(PROTOC) -Iprotos --descriptor_set_out=tests/testdata/hello.binpb \
+	  --include_source_info --include_imports protos/hello.proto
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -x --ignore=tests/test_serving.py \
+	  --ignore=tests/test_models.py
+
+bench-cpu:
+	GGRMCP_BENCH_CPU=1 GGRMCP_BENCH_SESSIONS=8 GGRMCP_BENCH_CALLS=24 \
+	  $(PY) bench.py
+
+# End-to-end smoke: graft entry + multichip dry run on the CPU mesh.
+smoke:
+	$(CPU_ENV) $(PY) __graft_entry__.py
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -16,6 +16,7 @@ streaming path is enabled instead of being rejected outright.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 import time
 from typing import Any, AsyncIterator, Optional
@@ -106,10 +107,13 @@ class ServiceDiscoverer:
             for i, target in enumerate(targets)
         ]
         self.allow_streaming_tools = allow_streaming_tools
-        # tool name → (MethodInfo, Backend). Immutable dict, swapped
-        # whole on rediscovery — lock-free reads under the GIL, the
-        # Python analogue of atomic.Pointer (discovery.go:21,122-127).
-        self._tools: dict[str, tuple[MethodInfo, Optional[Backend]]] = {}
+        # tool name → (MethodInfo, [replica backends]). Immutable dict,
+        # swapped whole on rediscovery — lock-free reads under the GIL,
+        # the Python analogue of atomic.Pointer (discovery.go:21,
+        # 122-127). Multiple backends serving the SAME method full name
+        # are DP replicas: calls round-robin over the healthy ones.
+        self._tools: dict[str, tuple[MethodInfo, list[Backend]]] = {}
+        self._rr = itertools.count()
         self._watchdog_task: Optional[asyncio.Task] = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -185,21 +189,26 @@ class ServiceDiscoverer:
                         mi.service_description = (
                             mi.service_description or fds_mi.service_description
                         )
-                if mi.tool_name in registry:
+                existing = registry.get(mi.tool_name)
+                if existing is None:
+                    registry[mi.tool_name] = (mi, [backend])
+                elif existing[0].full_name == mi.full_name:
+                    # Same method on another backend → DP replica.
+                    existing[1].append(backend)
+                else:
                     logger.warning(
                         "tool name collision across backends: %s (%s on %s "
                         "shadows %s)",
                         mi.tool_name, mi.full_name, backend.target,
-                        registry[mi.tool_name][0].full_name,
+                        existing[0].full_name,
                     )
-                registry[mi.tool_name] = (mi, backend)
+                    registry[mi.tool_name] = (mi, [backend])
 
         # Descriptor-set-only methods (no live backend yet) are exposed
-        # for listing and routed to the first backend on call.
-        default_backend = self.backends[0] if self.backends else None
+        # for listing and routed across all backends on call.
         for tool_name, mi in fds_methods.items():
             if tool_name not in registry:
-                registry[tool_name] = (mi, default_backend)
+                registry[tool_name] = (mi, list(self.backends))
 
         self._tools = registry  # atomic swap
         logger.info("tool registry: %d tools", len(registry))
@@ -295,12 +304,19 @@ class ServiceDiscoverer:
     # -- invocation ---------------------------------------------------------
 
     def _route(self, tool_name: str) -> tuple[MethodInfo, Backend]:
+        """Pick the serving replica: round-robin over healthy backends,
+        falling back to any connected one (per-shard routing from the
+        north star; DP replicas share a tool name)."""
         entry = self._tools.get(tool_name)
         if entry is None:
             raise ToolNotFoundError(f"tool not found: {tool_name}")
-        method, backend = entry
-        if backend is None or backend.invoker is None:
+        method, backends = entry
+        candidates = [
+            b for b in backends if b.invoker is not None and b.healthy
+        ] or [b for b in backends if b.invoker is not None]
+        if not candidates:
             raise ConnectionError(f"no live backend for tool {tool_name}")
+        backend = candidates[next(self._rr) % len(candidates)]
         return method, backend
 
     async def invoke_by_tool(

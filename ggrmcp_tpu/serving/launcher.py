@@ -1,0 +1,40 @@
+"""TPU co-launch mode: gateway + sidecar in one process tree.
+
+The north star's `cmd/grmcp --tpu` (BASELINE.json): the gateway
+co-launches a JAX serving sidecar, waits for it to come up, and
+registers it through the ordinary Service Discoverer — from the MCP
+client's perspective it is just another discovered gRPC backend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ggrmcp_tpu.core.config import Config
+from ggrmcp_tpu.gateway.app import Gateway, setup_logging
+
+logger = logging.getLogger("ggrmcp.serving.launcher")
+
+
+async def _run(cfg: Config, extra_targets: list[str]) -> None:
+    from ggrmcp_tpu.serving.sidecar import Sidecar
+
+    sidecar = Sidecar(cfg.serving)
+    port = await sidecar.start(cfg.serving.port)
+    targets = [f"localhost:{port}"]
+    for target in extra_targets:
+        if target not in targets and target != cfg.grpc.target:
+            targets.append(target)
+    logger.info("co-launched sidecar on :%d; gateway backends: %s", port, targets)
+
+    gateway = Gateway(cfg, targets=targets)
+    try:
+        await gateway.run_forever()
+    finally:
+        await sidecar.stop()
+
+
+def run_gateway_with_sidecar(cfg: Config, extra_targets: list[str] | None = None) -> None:
+    setup_logging(cfg)
+    asyncio.run(_run(cfg, extra_targets or []))

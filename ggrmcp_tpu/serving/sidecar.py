@@ -36,13 +36,6 @@ from ggrmcp_tpu.serving.tokenizer import load_tokenizer
 
 logger = logging.getLogger("ggrmcp.serving.sidecar")
 
-SERVICES = [
-    "ggrmcp.tpu.EmbedService",
-    "ggrmcp.tpu.GenerateService",
-    "ggrmcp.tpu.ModelInfoService",
-]
-
-
 class Sidecar:
     """Owns the engines and the grpc.aio server."""
 
@@ -83,11 +76,8 @@ class Sidecar:
     # ------------------------------------------------------------------
 
     async def embed(self, request: serving_pb2.EmbedRequest, context):
-        if self.embedding is None:
-            await context.abort(
-                grpc.StatusCode.FAILED_PRECONDITION,
-                f"model {self.serving.model} does not serve embeddings",
-            )
+        # Registration is family-scoped (start()), so the engine exists.
+        assert self.embedding is not None
         t0 = time.perf_counter()
         has_token_ids = (
             request.token_ids.shape
@@ -148,11 +138,7 @@ class Sidecar:
         )
 
     async def generate(self, request: serving_pb2.GenerateRequest, context):
-        if self.generation is None or self.batcher is None:
-            await context.abort(
-                grpc.StatusCode.FAILED_PRECONDITION,
-                f"model {self.serving.model} does not serve generation",
-            )
+        assert self.generation is not None and self.batcher is not None
         t0 = time.perf_counter()
         prompt = self._prompt_ids(request)
         max_new = request.max_new_tokens or 64
@@ -179,11 +165,7 @@ class Sidecar:
         )
 
     async def generate_stream(self, request: serving_pb2.GenerateRequest, context):
-        if self.generation is None or self.batcher is None:
-            await context.abort(
-                grpc.StatusCode.FAILED_PRECONDITION,
-                f"model {self.serving.model} does not serve generation",
-            )
+        assert self.generation is not None and self.batcher is not None
         prompt = self._prompt_ids(request)
         max_new = min(
             request.max_new_tokens or 64, self.serving.batching.max_decode_steps
@@ -251,26 +233,36 @@ class Sidecar:
 
     async def start(self, port: Optional[int] = None) -> int:
         self.server = grpc.aio.server()
-        add_service(
-            self.server, "ggrmcp.tpu.EmbedService",
-            {"Embed": MethodDef(
-                self.embed, serving_pb2.EmbedRequest, serving_pb2.EmbedResponse
-            )},
-        )
-        add_service(
-            self.server, "ggrmcp.tpu.GenerateService",
-            {
-                "Generate": MethodDef(
-                    self.generate,
-                    serving_pb2.GenerateRequest, serving_pb2.GenerateResponse,
-                ),
-                "GenerateStream": MethodDef(
-                    self.generate_stream,
-                    serving_pb2.GenerateRequest, serving_pb2.GenerateChunk,
-                    server_streaming=True,
-                ),
-            },
-        )
+        # Register only the services this model family actually serves —
+        # a gateway pooling an embed sidecar and a generate sidecar must
+        # not see colliding tool names (discovery is name-keyed).
+        services = ["ggrmcp.tpu.ModelInfoService"]
+        if self.embedding is not None:
+            services.append("ggrmcp.tpu.EmbedService")
+            add_service(
+                self.server, "ggrmcp.tpu.EmbedService",
+                {"Embed": MethodDef(
+                    self.embed,
+                    serving_pb2.EmbedRequest, serving_pb2.EmbedResponse,
+                )},
+            )
+        if self.generation is not None:
+            services.append("ggrmcp.tpu.GenerateService")
+            add_service(
+                self.server, "ggrmcp.tpu.GenerateService",
+                {
+                    "Generate": MethodDef(
+                        self.generate,
+                        serving_pb2.GenerateRequest,
+                        serving_pb2.GenerateResponse,
+                    ),
+                    "GenerateStream": MethodDef(
+                        self.generate_stream,
+                        serving_pb2.GenerateRequest, serving_pb2.GenerateChunk,
+                        server_streaming=True,
+                    ),
+                },
+            )
         add_service(
             self.server, "ggrmcp.tpu.ModelInfoService",
             {"GetModelInfo": MethodDef(
@@ -278,7 +270,7 @@ class Sidecar:
                 serving_pb2.ModelInfoRequest, serving_pb2.ModelInfoResponse,
             )},
         )
-        ReflectionService(SERVICES).attach(self.server)
+        ReflectionService(services).attach(self.server)
         self.health.attach(self.server)
         bind = port if port is not None else self.serving.port
         self.port = self.server.add_insecure_port(f"0.0.0.0:{bind}")

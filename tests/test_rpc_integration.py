@@ -212,6 +212,53 @@ class TestStreaming:
             assert chunks == [{"message": "Hello, s!"}]
 
 
+class TestReplicaRouting:
+    async def test_same_service_on_two_backends_round_robins(self):
+        async with InProcessBackend() as b1, InProcessBackend() as b2:
+            d = ServiceDiscoverer(
+                [b1.target, b2.target], GRPCConfig(connect_timeout_s=5.0)
+            )
+            await d.connect()
+            await d.discover_services()
+            # identical services → one tool, two replicas
+            entry = d._tools["hello_helloservice_sayhello"]
+            assert len(entry[1]) == 2
+            # consecutive routes alternate backends
+            targets = {
+                d._route("hello_helloservice_sayhello")[1].target
+                for _ in range(4)
+            }
+            assert targets == {b1.target, b2.target}
+            # calls succeed on both
+            for i in range(4):
+                result = await d.invoke_by_tool(
+                    "hello_helloservice_sayhello", {"name": f"r{i}"}
+                )
+                assert result["message"] == f"Hello, r{i}!"
+            await d.close()
+
+    async def test_replica_failover(self):
+        async with InProcessBackend() as b1:
+            b2 = InProcessBackend()
+            await b2.__aenter__()
+            d = ServiceDiscoverer(
+                [b1.target, b2.target], GRPCConfig(connect_timeout_s=5.0)
+            )
+            await d.connect()
+            await d.discover_services()
+            # kill one replica; mark it unhealthy as the watchdog would
+            await b2.__aexit__()
+            for backend in d.backends:
+                if backend.target == b2.target:
+                    backend.healthy = False
+            for i in range(4):  # all calls land on the survivor
+                result = await d.invoke_by_tool(
+                    "hello_helloservice_sayhello", {"name": f"f{i}"}
+                )
+                assert result["message"] == f"Hello, f{i}!"
+            await d.close()
+
+
 class TestDescriptorSet:
     async def test_fds_discovery_without_backend(self, testdata_dir):
         cfg = GRPCConfig()

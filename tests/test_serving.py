@@ -292,7 +292,9 @@ class TestSidecarGeneration:
             assert resp.num_devices == 8
             assert resp.platform == "cpu"
 
-    async def test_embed_rejected_on_llama(self):
+    async def test_embed_not_registered_on_llama(self):
+        # A generation sidecar does not even expose EmbedService —
+        # family-scoped registration keeps pooled tool names collision-free.
         async with sidecar_env() as (_, channel, _port):
             embed = _unary(
                 channel, "/ggrmcp.tpu.EmbedService/Embed",
@@ -300,7 +302,7 @@ class TestSidecarGeneration:
             )
             with pytest.raises(grpc.aio.AioRpcError) as exc:
                 await embed(serving_pb2.EmbedRequest(texts=["x"]))
-            assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+            assert exc.value.code() == grpc.StatusCode.UNIMPLEMENTED
 
 
 class TestSidecarEmbedding:
@@ -317,6 +319,72 @@ class TestSidecarEmbedding:
             assert vecs.shape == (2, 128)
             assert resp.model_id == "bert-tiny"
             assert resp.compute_ms > 0
+
+
+class TestCentralizedGateway:
+    """BASELINE.md config #5: one gateway, embed + generate backends
+    (two sidecars standing in for two TPU slices)."""
+
+    async def test_two_model_backends_one_gateway(self):
+        import aiohttp
+
+        from ggrmcp_tpu.core import config as cfgmod
+        from ggrmcp_tpu.gateway.app import Gateway
+
+        gen_side = Sidecar(serving_cfg(model="tiny-llama"))
+        gen_port = await gen_side.start(0)
+        emb_side = Sidecar(serving_cfg(model="bert-tiny"))
+        emb_port = await emb_side.start(0)
+
+        cfg = cfgmod.default()
+        cfg.server.host = "127.0.0.1"
+        cfg.server.port = 0
+        cfg.grpc.reconnect.enabled = False
+        gw = Gateway(
+            cfg, targets=[f"localhost:{gen_port}", f"localhost:{emb_port}"]
+        )
+        await gw.start()
+        try:
+            async with aiohttp.ClientSession(
+                base_url=f"http://127.0.0.1:{gw.port}"
+            ) as client:
+                resp = await client.post("/", json={
+                    "jsonrpc": "2.0", "method": "tools/call", "id": 1,
+                    "params": {
+                        "name": "ggrmcp_tpu_generateservice_generate",
+                        "arguments": {"prompt": "x", "maxNewTokens": 3},
+                    },
+                })
+                gen_data = await resp.json()
+                assert "error" not in gen_data, gen_data
+                gen_payload = json.loads(
+                    gen_data["result"]["content"][0]["text"]
+                )
+                assert gen_payload["modelId"] == "tiny-llama"
+
+                resp = await client.post("/", json={
+                    "jsonrpc": "2.0", "method": "tools/call", "id": 2,
+                    "params": {
+                        "name": "ggrmcp_tpu_embedservice_embed",
+                        "arguments": {"texts": ["hello"]},
+                    },
+                })
+                emb_data = await resp.json()
+                assert "error" not in emb_data, emb_data
+                emb_payload = json.loads(
+                    emb_data["result"]["content"][0]["text"]
+                )
+                assert emb_payload["modelId"] == "bert-tiny"
+
+                # stats report both backends healthy
+                resp = await client.get("/stats")
+                stats = await resp.json()
+                assert len(stats["backends"]) == 2
+                assert all(b["healthy"] for b in stats["backends"])
+        finally:
+            await gw.stop()
+            await gen_side.stop()
+            await emb_side.stop()
 
 
 class TestGatewayToSidecar:
@@ -345,8 +413,10 @@ class TestGatewayToSidecar:
                 })
                 tools = {t["name"] for t in (await resp.json())["result"]["tools"]}
                 assert "ggrmcp_tpu_generateservice_generate" in tools
-                assert "ggrmcp_tpu_embedservice_embed" in tools
                 assert "ggrmcp_tpu_generateservice_generatestream" in tools
+                assert "ggrmcp_tpu_modelinfoservice_getmodelinfo" in tools
+                # family-scoped: a llama sidecar exposes no embed tool
+                assert "ggrmcp_tpu_embedservice_embed" not in tools
 
                 resp = await client.post("/", json={
                     "jsonrpc": "2.0", "method": "tools/call", "id": 2,
