@@ -95,7 +95,15 @@ def main(argv: list[str] | None = None) -> int:
         if args.tpu:
             from ggrmcp_tpu.serving.launcher import run_gateway_with_sidecar
 
-            run_gateway_with_sidecar(cfg, targets)
+            # Only flag presence distinguishes "default placeholder
+            # target" from an explicitly requested external backend —
+            # decide here, not in the launcher, so `--tpu --backend
+            # localhost:50051` still joins the pool.
+            explicit = bool(
+                args.backend or args.grpc_host or args.grpc_port
+                or args.config
+            )
+            run_gateway_with_sidecar(cfg, targets if explicit else [])
         else:
             from ggrmcp_tpu.gateway.app import run
 

@@ -1,26 +1,29 @@
 """Model registry: name → (family, config).
 
 The serving sidecar resolves `ServingConfig.model` here. Families:
-"llama" (generation) and "bert" (embeddings).
+"llama" (dense generation), "moe" (sparse-MoE generation, served by the
+same engine), and "bert" (embeddings).
 """
 
 from __future__ import annotations
 
 from typing import Any
 
-from ggrmcp_tpu.models import bert, llama
+from ggrmcp_tpu.models import bert, llama, moe
 
 
 def get_model(name: str) -> tuple[str, Any]:
     if name in llama.CONFIGS:
         return "llama", llama.CONFIGS[name]
+    if name in moe.CONFIGS:
+        return "moe", moe.CONFIGS[name]
     if name in bert.CONFIGS:
         return "bert", bert.CONFIGS[name]
     raise KeyError(
         f"unknown model {name!r}; available: "
-        f"{sorted([*llama.CONFIGS, *bert.CONFIGS])}"
+        f"{sorted([*llama.CONFIGS, *moe.CONFIGS, *bert.CONFIGS])}"
     )
 
 
 def available_models() -> list[str]:
-    return sorted([*llama.CONFIGS, *bert.CONFIGS])
+    return sorted([*llama.CONFIGS, *moe.CONFIGS, *bert.CONFIGS])

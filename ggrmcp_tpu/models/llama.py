@@ -162,7 +162,7 @@ def cache_specs() -> KVCache:
 # ---------------------------------------------------------------------------
 
 
-def _layer(
+def attention_block(
     x: jnp.ndarray,  # [B, S, D]
     layer_params: Params,  # one layer's slice (no leading L)
     cfg: LlamaConfig,
@@ -171,10 +171,11 @@ def _layer(
     cache_v: Optional[jnp.ndarray],
     cache_len: Optional[jnp.ndarray],  # [B]
 ):
+    """Pre-norm GQA attention with residual; shared by the dense and MoE
+    decoder families. Returns (x + attn, (cache_k, cache_v) or None)."""
     b, s, d = x.shape
     h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
-    # Attention
     normed = common.rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
     qkv = normed @ layer_params["wqkv"]  # [B, S, (H+2KVH)*Dh]
     q, kv = jnp.split(qkv, [h * hd], axis=-1)
@@ -212,15 +213,31 @@ def _layer(
     attn_out = attn_out.reshape(b, s, h * hd) @ layer_params["wo"]
     x = x + attn_out
 
+    if cache_k is not None:
+        return x, (cache_k, cache_v)
+    return x, None
+
+
+def _layer(
+    x: jnp.ndarray,
+    layer_params: Params,
+    cfg: LlamaConfig,
+    positions: jnp.ndarray,
+    cache_k: Optional[jnp.ndarray],
+    cache_v: Optional[jnp.ndarray],
+    cache_len: Optional[jnp.ndarray],
+):
+    x, new_cache = attention_block(
+        x, layer_params, cfg, positions, cache_k, cache_v, cache_len
+    )
+
     # SwiGLU MLP
     normed = common.rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
     gate = jax.nn.silu(normed @ layer_params["w_gate"])
     up = normed @ layer_params["w_up"]
     x = x + (gate * up) @ layer_params["w_down"]
 
-    if cache_k is not None:
-        return x, (cache_k, cache_v)
-    return x, None
+    return x, new_cache
 
 
 def forward(

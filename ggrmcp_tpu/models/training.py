@@ -30,12 +30,23 @@ class TrainState(NamedTuple):
 def lm_loss(
     params, cfg: llama_mod.LlamaConfig, tokens: jnp.ndarray
 ) -> jnp.ndarray:
-    """Next-token cross entropy over [B, S] with shift-by-one targets."""
-    logits, _ = llama_mod.forward(params, cfg, tokens[:, :-1])
+    """Next-token cross entropy over [B, S] with shift-by-one targets.
+    Sparse-MoE configs additionally carry the router load-balance
+    auxiliary loss (weight `cfg.router_aux_weight`)."""
+    from ggrmcp_tpu.models import moe as moe_mod
+
+    aux = 0.0
+    if isinstance(cfg, moe_mod.MoEConfig):
+        logits, _, router_aux = moe_mod.forward_with_aux(
+            params, cfg, tokens[:, :-1]
+        )
+        aux = cfg.router_aux_weight * router_aux
+    else:
+        logits, _ = llama_mod.forward(params, cfg, tokens[:, :-1])
     targets = tokens[:, 1:]
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-    return -picked.mean()
+    return -picked.mean() + aux
 
 
 def make_optimizer(
@@ -50,7 +61,10 @@ def init_train_state(
     optimizer: Optional[optax.GradientTransformation] = None,
 ) -> TrainState:
     optimizer = optimizer or make_optimizer()
-    params = llama_mod.init_params(key, cfg)
+    from ggrmcp_tpu.models import moe as moe_mod
+
+    fam = moe_mod if isinstance(cfg, moe_mod.MoEConfig) else llama_mod
+    params = fam.init_params(key, cfg)
     return TrainState(
         params=params,
         opt_state=optimizer.init(params),

@@ -113,7 +113,10 @@ class ServiceDiscoverer:
         # 122-127). Multiple backends serving the SAME method full name
         # are DP replicas: calls round-robin over the healthy ones.
         self._tools: dict[str, tuple[MethodInfo, list[Backend]]] = {}
-        self._rr = itertools.count()
+        # Per-tool round-robin cursors: a single shared counter would
+        # let interleaved multi-tool traffic pin each tool to one
+        # replica (tool A always landing on even counts, B on odd).
+        self._rr: dict[str, itertools.count] = {}
         self._watchdog_task: Optional[asyncio.Task] = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -316,7 +319,8 @@ class ServiceDiscoverer:
         ] or [b for b in backends if b.invoker is not None]
         if not candidates:
             raise ConnectionError(f"no live backend for tool {tool_name}")
-        backend = candidates[next(self._rr) % len(candidates)]
+        cursor = self._rr.setdefault(tool_name, itertools.count())
+        backend = candidates[next(cursor) % len(candidates)]
         return method, backend
 
     async def invoke_by_tool(

@@ -19,7 +19,6 @@ import asyncio
 import dataclasses
 import logging
 import time
-from functools import partial
 from typing import AsyncIterator, Optional
 
 import jax
@@ -83,21 +82,39 @@ class ContinuousBatcher:
         self.seeds = np.zeros((b,), np.uint32)
         self.step_counter = 0
 
+        # Model family (dense llama or sparse MoE) — same forward
+        # contract; MoE additionally takes a validity mask so padding
+        # and parked slots never compete for expert capacity.
+        self.fam = getattr(engine, "fam", llama_mod)
+        self._is_moe = self.fam is not llama_mod
+
         # jitted: one decode tick for the whole slot pool
         self._tick = jax.jit(self._tick_impl, donate_argnums=(1,))
         # jitted: scatter one prefilled sequence into the shared cache
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
-        # prefill reuses the engine's jitted single-sequence path
-        self._prefill = jax.jit(
-            partial(llama_mod.forward, cfg=engine.cfg), static_argnames=()
-        )
+        # jitted single-sequence prefill (family-dispatched)
+        self._prefill = jax.jit(self._prefill_impl)
 
     # -- jitted bodies ------------------------------------------------------
 
-    def _tick_impl(self, tokens, cache, seeds, step, temps, ks, ps):
-        logits, cache = llama_mod.forward(
-            self.engine.params, self.engine.cfg, tokens[:, None], cache
-        )
+    def _prefill_impl(self, params, tokens, cache, true_len):
+        if self._is_moe:
+            valid = jnp.arange(tokens.shape[1])[None, :] < true_len
+            return self.fam.forward(
+                params, self.engine.cfg, tokens, cache, valid=valid
+            )
+        return self.fam.forward(params, self.engine.cfg, tokens, cache)
+
+    def _tick_impl(self, tokens, cache, seeds, step, temps, ks, ps, active):
+        if self._is_moe:
+            logits, cache = self.fam.forward(
+                self.engine.params, self.engine.cfg, tokens[:, None], cache,
+                valid=active[:, None],
+            )
+        else:
+            logits, cache = self.fam.forward(
+                self.engine.params, self.engine.cfg, tokens[:, None], cache
+            )
         nxt = sample_dynamic(logits[:, -1], seeds, step, temps, ks, ps)
         return nxt, cache
 
@@ -178,7 +195,19 @@ class ContinuousBatcher:
                 await self._wake.wait()
                 continue
             # One batched decode tick (device-bound → executor).
-            await loop.run_in_executor(None, self._tick_sync)
+            try:
+                await loop.run_in_executor(None, self._tick_sync)
+            except Exception:
+                # Fail every active request rather than dying silently;
+                # the loop stays alive for future submissions.
+                logger.exception("decode tick failed; failing active slots")
+                for slot in self.slots:
+                    if slot.active and slot.request is not None:
+                        self._loop_ref.call_soon_threadsafe(
+                            slot.request.out.put_nowait, ([], "error")
+                        )
+                    slot.active = False
+                    slot.request = None
             await asyncio.sleep(0)  # let handlers drain queues
 
     async def _admit(self) -> int:
@@ -203,9 +232,21 @@ class ContinuousBatcher:
             if request.cancelled:
                 continue
             slot_idx = self._free_slots()[0]
-            await loop.run_in_executor(
-                None, self._prefill_into_slot, slot_idx, request
-            )
+            try:
+                await loop.run_in_executor(
+                    None, self._prefill_into_slot, slot_idx, request
+                )
+            except Exception:
+                # Fail THIS request; a poisoned prompt must not kill
+                # the batching loop (every later submit would hang).
+                logger.exception("prefill failed for slot %d", slot_idx)
+                slot = self.slots[slot_idx]
+                slot.active = False
+                slot.request = None
+                self._loop_ref.call_soon_threadsafe(
+                    request.out.put_nowait, ([], "error")
+                )
+                continue
             admitted += 1
         return admitted
 
@@ -217,7 +258,8 @@ class ContinuousBatcher:
         # Single-sequence prefill producing this row's cache prefix.
         mini_cache = llama_mod.KVCache.create(self.engine.cfg, 1, s)
         logits, mini_cache = self._prefill(
-            self.engine.params, tokens=jnp.asarray(tokens), cache=mini_cache
+            self.engine.params, jnp.asarray(tokens), mini_cache,
+            jnp.int32(len(prompt)),
         )
         first = sample_dynamic(
             logits[:, len(prompt) - 1],
@@ -249,11 +291,12 @@ class ContinuousBatcher:
 
     def _tick_sync(self) -> None:
         self.step_counter += 1
+        active = np.array([s.active for s in self.slots], bool)
         nxt, self.cache = self._tick(
             jnp.asarray(self.cur_tokens), self.cache,
             jnp.asarray(self.seeds), jnp.int32(self.step_counter),
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
-            jnp.asarray(self.top_ps),
+            jnp.asarray(self.top_ps), jnp.asarray(active),
         )
         nxt = np.asarray(nxt)
         for i, slot in enumerate(self.slots):

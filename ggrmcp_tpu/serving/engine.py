@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding
 from ggrmcp_tpu.core.config import ServingConfig
 from ggrmcp_tpu.models import bert as bert_mod
 from ggrmcp_tpu.models import llama as llama_mod
+from ggrmcp_tpu.models import moe as moe_mod
 from ggrmcp_tpu.models.common import count_params
 from ggrmcp_tpu.ops.sampling import SamplingConfig, sample
 from ggrmcp_tpu.parallel import mesh as mesh_mod
@@ -95,7 +96,9 @@ def _sharded_init(init_fn, specs, mesh: Mesh, key):
 
 
 class GenerationEngine:
-    """Llama-family generation: prefill + decode + fused generate."""
+    """Decoder-family generation (dense Llama or sparse MoE): prefill +
+    decode + fused generate. The family module supplies init_params /
+    param_specs / forward / cache_specs with a shared contract."""
 
     def __init__(
         self,
@@ -106,6 +109,7 @@ class GenerationEngine:
         seed: int = 0,
     ):
         self.cfg = cfg
+        self.fam = moe_mod if isinstance(cfg, moe_mod.MoEConfig) else llama_mod
         self.serving = serving or ServingConfig()
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh(
             self.serving.mesh
@@ -113,8 +117,8 @@ class GenerationEngine:
         if params is None:
             t0 = time.monotonic()
             params = _sharded_init(
-                partial(llama_mod.init_params, cfg=cfg),
-                llama_mod.param_specs(cfg), self.mesh,
+                partial(self.fam.init_params, cfg=cfg),
+                self.fam.param_specs(cfg), self.mesh,
                 jax.random.PRNGKey(seed),
             )
             logger.info(
@@ -122,7 +126,7 @@ class GenerationEngine:
                 cfg.name, count_params(params) / 1e6, time.monotonic() - t0,
             )
         else:
-            params = _shard_params(params, llama_mod.param_specs(cfg), self.mesh)
+            params = _shard_params(params, self.fam.param_specs(cfg), self.mesh)
         self.params = params
         self._prefill_fn = jax.jit(
             self._prefill_impl, donate_argnums=(2,), static_argnums=()
@@ -141,7 +145,17 @@ class GenerationEngine:
     def _prefill_impl(self, tokens, true_len, cache):
         """tokens [B,S] right-padded; true_len [B]. Returns
         (last_logits [B,V], cache with length=true_len)."""
-        logits, cache = llama_mod.forward(self.params, self.cfg, tokens, cache)
+        if self.fam is moe_mod:
+            # Padding must not compete for expert capacity (routing is
+            # batch-global); dense forwards are pad-invariant already.
+            valid = jnp.arange(tokens.shape[1])[None, :] < true_len[:, None]
+            logits, cache = self.fam.forward(
+                self.params, self.cfg, tokens, cache, valid=valid
+            )
+        else:
+            logits, cache = self.fam.forward(
+                self.params, self.cfg, tokens, cache
+            )
         idx = jnp.maximum(true_len - 1, 0)
         last = jnp.take_along_axis(
             logits, idx[:, None, None], axis=1
@@ -151,7 +165,7 @@ class GenerationEngine:
 
     def _decode_impl(self, tokens, cache, rng, step, sampling: SamplingConfig):
         """tokens [B,1] → (next [B], cache)."""
-        logits, cache = llama_mod.forward(self.params, self.cfg, tokens, cache)
+        logits, cache = self.fam.forward(self.params, self.cfg, tokens, cache)
         key = jax.random.fold_in(rng, step)
         next_tok = sample(logits[:, -1], key, sampling)
         return next_tok, cache
@@ -172,7 +186,7 @@ class GenerationEngine:
 
         def step(carry, i):
             cur, cache, done = carry
-            logits, cache = llama_mod.forward(
+            logits, cache = self.fam.forward(
                 self.params, self.cfg, cur[:, None], cache
             )
             key = jax.random.fold_in(rng, i + 1)
@@ -199,7 +213,7 @@ class GenerationEngine:
             self.cfg.num_layers, batch, max_len,
             self.cfg.num_kv_heads, self.cfg.head_dim,
         )
-        specs = llama_mod.cache_specs()
+        specs = self.fam.cache_specs()
         specs = llama_mod.KVCache(
             k=mesh_mod.compatible_spec(specs.k, kv_shape, self.mesh),
             v=mesh_mod.compatible_spec(specs.v, kv_shape, self.mesh),

@@ -22,9 +22,11 @@ async def _run(cfg: Config, extra_targets: list[str]) -> None:
 
     sidecar = Sidecar(cfg.serving)
     port = await sidecar.start(cfg.serving.port)
+    # Callers pass only explicitly requested external backends
+    # (__main__.py decides placeholder-vs-explicit from flag presence).
     targets = [f"localhost:{port}"]
     for target in extra_targets:
-        if target not in targets and target != cfg.grpc.target:
+        if target not in targets:
             targets.append(target)
     logger.info("co-launched sidecar on :%d; gateway backends: %s", port, targets)
 

@@ -55,7 +55,7 @@ class Sidecar:
             logger.info(
                 "restored params from %s", self.serving.checkpoint_path
             )
-        if family == "llama":
+        if family in ("llama", "moe"):
             self.generation = GenerationEngine(
                 model_cfg, self.serving, mesh=mesh, params=params
             )
@@ -152,6 +152,10 @@ class Sidecar:
             token_ids.extend(chunk_ids)
             if reason:
                 finish = reason
+        if finish == "error":
+            await context.abort(
+                grpc.StatusCode.INTERNAL, "generation failed on the backend"
+            )
         text = self.tokenizer.decode(token_ids)
         text, finish = _apply_stops(text, list(request.stop), finish)
         return serving_pb2.GenerateResponse(

@@ -1,0 +1,201 @@
+"""Sparse-MoE family tests on the 8-device CPU mesh: routing
+invariants, forward/cache consistency, expert-parallel sharding, and
+engine integration (same serving contract as the dense family)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.core.config import BatchingConfig, MeshConfig, ServingConfig
+from ggrmcp_tpu.models import get_model, llama, moe
+from ggrmcp_tpu.ops.sampling import SamplingConfig
+from ggrmcp_tpu.parallel import mesh as mesh_mod
+from ggrmcp_tpu.serving.engine import GenerationEngine
+
+CFG = moe.CONFIGS["tiny-moe"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return moe.init_params(jax.random.PRNGKey(0), CFG)
+
+
+class TestRouting:
+    def test_dispatch_combine_shapes_and_mass(self):
+        t, d = 32, CFG.hidden_dim
+        x = jax.random.normal(jax.random.PRNGKey(0), (t, d))
+        router = jax.random.normal(
+            jax.random.PRNGKey(1), (d, CFG.num_experts)
+        ) * 0.1
+        cap = moe._capacity(CFG, t)
+        dispatch, combine, probs = moe.route(x, router, CFG, cap)
+        assert dispatch.shape == (t, CFG.num_experts, cap)
+        assert combine.shape == (t, CFG.num_experts, cap)
+        # Each (expert, slot) holds at most one token.
+        assert float(dispatch.sum(axis=0).max()) <= 1.0 + 1e-6
+        # Each token occupies at most experts_per_token slots.
+        per_token = dispatch.sum(axis=(1, 2))
+        assert float(per_token.max()) <= CFG.experts_per_token + 1e-6
+        # Combine mass per token is ≤ 1 (== 1 when nothing is dropped).
+        mass = combine.sum(axis=(1, 2))
+        assert float(mass.max()) <= 1.0 + 1e-5
+
+    def test_no_drops_at_high_capacity(self):
+        t, d = 16, CFG.hidden_dim
+        x = jax.random.normal(jax.random.PRNGKey(2), (t, d))
+        router = jax.random.normal(
+            jax.random.PRNGKey(3), (d, CFG.num_experts)
+        )
+        # Capacity = all tokens: nothing can drop, mass is exactly 1.
+        dispatch, combine, _ = moe.route(x, router, CFG, t)
+        np.testing.assert_allclose(
+            combine.sum(axis=(1, 2)), np.ones(t), atol=1e-5
+        )
+        assert float(dispatch.sum()) == t * CFG.experts_per_token
+
+    def test_capacity_static_and_padded(self):
+        assert moe._capacity(CFG, 64) % 8 == 0
+        assert moe._capacity(CFG, 1) >= 8
+
+
+class TestForward:
+    def test_forward_shapes_and_finite(self, params):
+        tokens = jnp.ones((2, 16), jnp.int32)
+        logits, cache = moe.forward(params, CFG, tokens)
+        assert logits.shape == (2, 16, CFG.vocab_size)
+        assert cache is None
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_aux_loss_finite_and_ordered(self, params):
+        tokens = jnp.ones((2, 16), jnp.int32)
+        _, _, aux = moe.forward_with_aux(params, CFG, tokens)
+        # Load-balance loss is ≥ 1 at perfect balance, bounded by E.
+        assert 0.99 <= float(aux) <= CFG.num_experts + 1e-3
+
+    def test_cached_decode_matches_full_forward(self, params):
+        """Prefill+decode through the cache must equal the uncached
+        forward on the same sequence — the serving-correctness invariant.
+
+        Uses a no-drop capacity factor: with binding capacity, which
+        tokens drop legitimately depends on the dispatch batch size
+        (GShard semantics), so equality only holds when capacity is
+        non-binding."""
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, capacity_factor=float(CFG.num_experts))
+        seq = jax.random.randint(jax.random.PRNGKey(4), (1, 12), 0, cfg.vocab_size)
+        full_logits, _ = moe.forward(params, cfg, seq)
+
+        cache = llama.KVCache.create(cfg, 1, 32)
+        _, cache = moe.forward(params, cfg, seq[:, :8], cache)
+        step_logits = []
+        for i in range(8, 12):
+            logits, cache = moe.forward(params, cfg, seq[:, i : i + 1], cache)
+            step_logits.append(logits[:, 0])
+        got = jnp.stack(step_logits, axis=1)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(full_logits[:, 8:12]), atol=2e-2,
+            rtol=2e-2,
+        )
+
+    def test_padding_does_not_affect_real_tokens(self, params):
+        """Routing is batch-global, so pad tokens must not consume
+        expert capacity: logits over real positions are identical no
+        matter how much padding the shape bucket adds."""
+        real = jax.random.randint(jax.random.PRNGKey(6), (2, 6), 0, CFG.vocab_size)
+
+        def run(pad_to):
+            tokens = jnp.zeros((2, pad_to), jnp.int32)
+            tokens = tokens.at[:, :6].set(real)
+            valid = jnp.arange(pad_to)[None, :] < 6
+            cache = llama.KVCache.create(CFG, 2, pad_to + 8)
+            logits, _ = moe.forward(
+                params, CFG, tokens, cache, valid=jnp.broadcast_to(valid, (2, pad_to))
+            )
+            return np.asarray(logits[:, :6])
+
+        np.testing.assert_allclose(run(8), run(32), atol=1e-5, rtol=1e-5)
+
+    def test_param_counts(self):
+        params = moe.init_params(jax.random.PRNGKey(0), CFG)
+        from ggrmcp_tpu.models.common import count_params
+
+        assert count_params(params) == moe.num_params(CFG)
+        assert moe.active_params_per_token(CFG) < moe.num_params(CFG)
+
+
+class TestExpertParallel:
+    def test_expert_sharded_forward_matches_single_device(self, params):
+        """EP over the expert axis must be numerically equivalent to the
+        unsharded forward (all-to-alls are layout, not math)."""
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(5), (2, 16), 0, CFG.vocab_size
+        )
+        want, _ = moe.forward(params, CFG, tokens)
+
+        mesh = mesh_mod.build_mesh(
+            MeshConfig(expert=4, data=0), jax.devices()[:8]
+        )
+        from jax.sharding import NamedSharding
+
+        specs = jax.tree_util.tree_map(
+            lambda s, x: NamedSharding(
+                mesh, mesh_mod.compatible_spec(s, x.shape, mesh)
+            ),
+            moe.param_specs(CFG), params,
+        )
+        sharded = jax.tree_util.tree_map(jax.device_put, params, specs)
+        with mesh:
+            got, _ = jax.jit(
+                lambda p, t: moe.forward(p, CFG, t)
+            )(sharded, tokens)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-2, rtol=2e-2
+        )
+
+
+class TestTraining:
+    def test_moe_train_step_decreases_loss(self):
+        from ggrmcp_tpu.models import training
+
+        state = training.init_train_state(jax.random.PRNGKey(0), CFG)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 17), 0, CFG.vocab_size
+        )
+        step = jax.jit(
+            lambda s, t: training.train_step(s, t, CFG)
+        )
+        _, loss0 = step(state, tokens)
+        state2, _ = step(state, tokens)
+        _, loss2 = step(state2, tokens)
+        assert bool(jnp.isfinite(loss0)) and bool(jnp.isfinite(loss2))
+        assert float(loss2) < float(loss0)
+
+
+class TestEngineIntegration:
+    def test_registry_resolves_moe(self):
+        family, cfg = get_model("tiny-moe")
+        assert family == "moe" and cfg is CFG
+
+    def test_generation_engine_serves_moe(self):
+        mesh = mesh_mod.build_mesh(
+            MeshConfig(expert=2, tensor=2, data=0), jax.devices()[:8]
+        )
+        engine = GenerationEngine(
+            CFG,
+            ServingConfig(
+                model="tiny-moe",
+                batching=BatchingConfig(max_batch_size=4, kv_cache_max_seq=128),
+            ),
+            mesh=mesh,
+        )
+        outs, reasons = engine.generate(
+            [[3, 1, 4], [1, 5, 9, 2]], max_new_tokens=6,
+            sampling=SamplingConfig(), seed=0,
+        )
+        assert len(outs) == 2
+        assert all(len(o) <= 6 for o in outs)
+        assert all(r in ("stop", "length") for r in reasons)
+        info = engine.model_info()
+        assert info["model_id"] == "tiny-moe"
