@@ -95,13 +95,17 @@ def main(argv: list[str] | None = None) -> int:
         if args.tpu:
             from ggrmcp_tpu.serving.launcher import run_gateway_with_sidecar
 
-            # Only flag presence distinguishes "default placeholder
-            # target" from an explicitly requested external backend —
-            # decide here, not in the launcher, so `--tpu --backend
-            # localhost:50051` still joins the pool.
+            # An external backend joins the pool only when one was
+            # actually configured: by --backend / host-port flags, or by
+            # a config file / env var that moved grpc.target off the
+            # built-in placeholder. `--config` alone (e.g. logging-only)
+            # must NOT pool the dead placeholder, and an env-configured
+            # target must not be dropped just because no flag was given.
+            from ggrmcp_tpu.core.config import GRPCConfig
+
             explicit = bool(
                 args.backend or args.grpc_host or args.grpc_port
-                or args.config
+                or cfg.grpc.target != GRPCConfig().target
             )
             run_gateway_with_sidecar(cfg, targets if explicit else [])
         else:

@@ -27,3 +27,11 @@ def get_model(name: str) -> tuple[str, Any]:
 
 def available_models() -> list[str]:
     return sorted([*llama.CONFIGS, *moe.CONFIGS, *bert.CONFIGS])
+
+
+def family_module(cfg):
+    """The decoder family module (llama or moe) implementing the shared
+    init_params / param_specs / forward / cache_specs contract for
+    `cfg`. Single dispatch point — engines, trainers and the pipeline
+    all resolve the family here."""
+    return moe if isinstance(cfg, moe.MoEConfig) else llama

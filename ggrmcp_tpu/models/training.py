@@ -27,6 +27,15 @@ class TrainState(NamedTuple):
     step: jnp.ndarray
 
 
+def next_token_xent(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross entropy: logits [B, S, V], targets [B, S].
+    The single loss definition shared by the plain and pipelined
+    trainers."""
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    return -picked.mean()
+
+
 def lm_loss(
     params, cfg: llama_mod.LlamaConfig, tokens: jnp.ndarray
 ) -> jnp.ndarray:
@@ -43,10 +52,7 @@ def lm_loss(
         aux = cfg.router_aux_weight * router_aux
     else:
         logits, _ = llama_mod.forward(params, cfg, tokens[:, :-1])
-    targets = tokens[:, 1:]
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    picked = jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-    return -picked.mean() + aux
+    return next_token_xent(logits, tokens[:, 1:]) + aux
 
 
 def make_optimizer(
@@ -61,10 +67,9 @@ def init_train_state(
     optimizer: Optional[optax.GradientTransformation] = None,
 ) -> TrainState:
     optimizer = optimizer or make_optimizer()
-    from ggrmcp_tpu.models import moe as moe_mod
+    from ggrmcp_tpu.models import family_module
 
-    fam = moe_mod if isinstance(cfg, moe_mod.MoEConfig) else llama_mod
-    params = fam.init_params(key, cfg)
+    params = family_module(cfg).init_params(key, cfg)
     return TrainState(
         params=params,
         opt_state=optimizer.init(params),

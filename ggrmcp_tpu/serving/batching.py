@@ -208,6 +208,13 @@ class ContinuousBatcher:
                         )
                     slot.active = False
                     slot.request = None
+                    slot.done = False
+                # The tick donated the shared cache, so its buffers are
+                # dead after an error — rebuild, or every future admit's
+                # _insert would fail and no request could ever succeed.
+                self.cache = self.engine.make_cache(
+                    len(self.slots), self.max_seq
+                )
             await asyncio.sleep(0)  # let handlers drain queues
 
     async def _admit(self) -> int:

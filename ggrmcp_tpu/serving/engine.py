@@ -108,8 +108,10 @@ class GenerationEngine:
         params=None,
         seed: int = 0,
     ):
+        from ggrmcp_tpu.models import family_module
+
         self.cfg = cfg
-        self.fam = moe_mod if isinstance(cfg, moe_mod.MoEConfig) else llama_mod
+        self.fam = family_module(cfg)
         self.serving = serving or ServingConfig()
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh(
             self.serving.mesh
@@ -308,7 +310,7 @@ class GenerationEngine:
                 )
 
     def model_info(self) -> dict:
-        return _model_info(self, "llama")
+        return _model_info(self, "moe" if self.fam is moe_mod else "llama")
 
 
 class EmbeddingEngine:

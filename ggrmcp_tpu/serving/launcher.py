@@ -22,8 +22,8 @@ async def _run(cfg: Config, extra_targets: list[str]) -> None:
 
     sidecar = Sidecar(cfg.serving)
     port = await sidecar.start(cfg.serving.port)
-    # Callers pass only explicitly requested external backends
-    # (__main__.py decides placeholder-vs-explicit from flag presence).
+    # Callers pass only explicitly configured external backends
+    # (__main__.py decides placeholder-vs-explicit from flags + config).
     targets = [f"localhost:{port}"]
     for target in extra_targets:
         if target not in targets:

@@ -209,6 +209,13 @@ class Sidecar:
                 )
                 return
             if reason:
+                if reason == "error":
+                    # Same contract as unary Generate: a backend failure
+                    # is an INTERNAL status, not a normal-looking stream.
+                    await context.abort(
+                        grpc.StatusCode.INTERNAL,
+                        "generation failed on the backend",
+                    )
                 yield serving_pb2.GenerateChunk(finish_reason=reason, done=True)
                 return
         yield serving_pb2.GenerateChunk(finish_reason="length", done=True)

@@ -1,0 +1,139 @@
+"""Pipeline parallelism over the `stage` axis: correctness vs the
+unstaged forward, PP × TP composition, and a staged training step —
+all on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.core.config import MeshConfig
+from ggrmcp_tpu.models import llama, moe, training
+from ggrmcp_tpu.parallel import mesh as mesh_mod
+from ggrmcp_tpu.parallel import pipeline
+
+CFG = llama.CONFIGS["tiny-llama"]  # 4 layers, float32
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _tokens(batch, seq=16, seed=7):
+    return jax.random.randint(
+        jax.random.PRNGKey(seed), (batch, seq), 0, CFG.vocab_size
+    ).astype(jnp.int32)
+
+
+class TestPipelineForward:
+    def test_matches_unstaged_stage4(self, params):
+        mesh = mesh_mod.build_mesh(MeshConfig(stage=4, data=0))
+        tokens = _tokens(4)
+        ref, _ = llama.forward(params, CFG, tokens)
+        pp_params = pipeline.shard_params_pp(params, CFG, mesh)
+        with mesh:
+            got = jax.jit(
+                lambda p, t: pipeline.pipeline_forward(p, CFG, t, mesh)
+            )(pp_params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_pp_composes_with_tp(self, params):
+        mesh = mesh_mod.build_mesh(MeshConfig(stage=2, tensor=2, data=0))
+        tokens = _tokens(4)
+        ref, _ = llama.forward(params, CFG, tokens)
+        pp_params = pipeline.shard_params_pp(params, CFG, mesh)
+        with mesh:
+            got = jax.jit(
+                lambda p, t: pipeline.pipeline_forward(p, CFG, t, mesh)
+            )(pp_params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_more_microbatches_than_stages(self, params):
+        mesh = mesh_mod.build_mesh(MeshConfig(stage=2, data=0))
+        tokens = _tokens(8)
+        ref, _ = llama.forward(params, CFG, tokens)
+        pp_params = pipeline.shard_params_pp(params, CFG, mesh)
+        with mesh:
+            got = jax.jit(
+                lambda p, t: pipeline.pipeline_forward(
+                    p, CFG, t, mesh, num_microbatches=4
+                )
+            )(pp_params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_single_stage_passthrough(self, params):
+        mesh = mesh_mod.build_mesh(MeshConfig(tensor=2, data=0))
+        tokens = _tokens(4)
+        ref, _ = llama.forward(params, CFG, tokens)
+        with mesh:
+            got = pipeline.pipeline_forward(params, CFG, tokens, mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_batch_not_divisible_raises(self, params):
+        mesh = mesh_mod.build_mesh(MeshConfig(stage=4, data=0))
+        with pytest.raises(ValueError, match="not divisible"):
+            pipeline.pipeline_layers(
+                params["layers"], CFG,
+                jnp.zeros((3, 8, CFG.hidden_dim)),
+                jnp.zeros((3, 8), jnp.int32), mesh,
+            )
+
+    def test_layers_not_divisible_raises(self, params):
+        # tiny-llama has 4 layers; 8 stages can't split them.
+        mesh = mesh_mod.build_mesh(MeshConfig(stage=8))
+        with pytest.raises(ValueError, match="layers not divisible"):
+            pipeline.pipeline_layers(
+                params["layers"], CFG,
+                jnp.zeros((8, 8, CFG.hidden_dim)),
+                jnp.zeros((8, 8), jnp.int32), mesh,
+            )
+
+
+class TestPipelineTraining:
+    def test_staged_train_step_matches_reference_loss(self, params):
+        mesh = mesh_mod.build_mesh(MeshConfig(stage=2, data=0))
+        tokens = _tokens(4, seq=17)
+        ref_loss = training.lm_loss(params, CFG, tokens)
+        state = training.init_train_state(jax.random.PRNGKey(0), CFG)
+        state = training.TrainState(
+            pipeline.shard_params_pp(state.params, CFG, mesh),
+            state.opt_state, state.step,
+        )
+        step_fn, _ = pipeline.make_pipeline_train_step(CFG, mesh)
+        with mesh:
+            state2, loss = step_fn(state, tokens)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-3)
+        assert int(state2.step) == 1
+        # Second step: loss changed (params actually updated).
+        with mesh:
+            _, loss2 = step_fn(state2, tokens)
+        assert float(loss2) != float(loss)
+        assert np.isfinite(float(loss2))
+
+
+class TestPipelineMoE:
+    def test_moe_pipeline_matches_unstaged(self):
+        cfg = moe.CONFIGS["tiny-moe"]
+        params = moe.init_params(jax.random.PRNGKey(0), cfg)
+        mesh = mesh_mod.build_mesh(MeshConfig(stage=2, expert=2, data=0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(9), (4, 12), 0, cfg.vocab_size
+        ).astype(jnp.int32)
+        # Expert capacity is computed per routed batch, and the pipeline
+        # routes each microbatch independently — so the reference is the
+        # unstaged forward applied per microbatch (same routing scope).
+        ref = jnp.concatenate(
+            [moe.forward(params, cfg, tokens[i : i + 2])[0] for i in (0, 2)]
+        )
+        pp_params = pipeline.shard_params_pp(params, cfg, mesh)
+        with mesh:
+            got = jax.jit(
+                lambda p, t: pipeline.pipeline_forward(p, cfg, t, mesh)
+            )(pp_params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)

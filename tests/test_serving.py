@@ -226,6 +226,46 @@ async def sidecar_env(model="tiny-llama"):
         await side.stop()
 
 
+class TestBatcherRecovery:
+    async def test_tick_failure_fails_request_then_recovers(self, gen_engine):
+        """A decode-tick crash fails in-flight requests with 'error' but
+        the batcher (whose tick donated the shared KV cache) rebuilds it
+        and serves subsequent requests normally."""
+        from ggrmcp_tpu.serving.batching import ContinuousBatcher
+
+        batcher = ContinuousBatcher(
+            gen_engine, BatchingConfig(max_batch_size=4, kv_cache_max_seq=256)
+        )
+        batcher.start()
+        try:
+            real_tick = batcher._tick_sync
+            calls = {"n": 0}
+
+            def flaky_tick():
+                calls["n"] += 1
+                raise RuntimeError("injected device failure")
+
+            batcher._tick_sync = flaky_tick
+            chunks = [
+                r async for _, r in batcher.submit(
+                    [3, 1, 4], 4, SamplingConfig(temperature=0.0)
+                )
+            ]
+            assert chunks[-1] == "error" and calls["n"] >= 1
+
+            batcher._tick_sync = real_tick
+            out: list[int] = []
+            reason = None
+            async for ids, reason in batcher.submit(
+                [3, 1, 4], 4, SamplingConfig(temperature=0.0)
+            ):
+                out.extend(ids)
+            assert reason in ("length", "stop")
+            assert len(out) >= 1
+        finally:
+            await batcher.stop()
+
+
 def _unary(channel, path, req_cls, resp_cls):
     return channel.unary_unary(
         path,
