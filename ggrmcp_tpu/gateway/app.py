@@ -64,6 +64,7 @@ class Gateway:
         app.router.add_get("/health", self.handler.handle_health)
         app.router.add_get("/metrics", self.handler.handle_metrics)
         app.router.add_get("/stats", self.handler.handle_stats)
+        app.router.add_get("/debug/traces", self.handler.handle_traces)
         return app
 
     async def start(self, connect_backends: bool = True) -> None:

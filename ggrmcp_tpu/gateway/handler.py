@@ -40,10 +40,12 @@ from ggrmcp_tpu.rpc.discovery import (
     ToolNotFoundError,
 )
 from ggrmcp_tpu.schema.builder import ToolBuilder
+from ggrmcp_tpu.utils import tracing
 
 logger = logging.getLogger("ggrmcp.gateway.handler")
 
 SESSION_HEADER = "Mcp-Session-Id"
+TRACE_RESPONSE_HEADER = "X-Trace-Id"
 
 
 class MCPHandler:
@@ -126,27 +128,36 @@ class MCPHandler:
                 "session rate limit exceeded",
             )
 
+        # One span per request; the incoming x-trace-id header (if any)
+        # continues the caller's trace, and the id is echoed back.
+        trace_id = request.headers.get(tracing.TRACE_HEADER) or tracing.new_id()
         try:
-            if method == "initialize":
-                result = self._handle_initialize()
-            elif method == "ping":
-                result = {}
-            elif method == "tools/list":
-                result = self._handle_tools_list()
-            elif method == "tools/call":
-                if self._wants_sse(request):
-                    return await self._handle_tools_call_sse(
-                        request, request_id, session, params
+            with tracing.tracer.span(
+                f"gateway.{method}", trace_id=trace_id, session=session.id[:8]
+            ):
+                if method == "initialize":
+                    result = self._handle_initialize()
+                elif method == "ping":
+                    result = {}
+                elif method == "tools/list":
+                    result = self._handle_tools_list()
+                elif method == "tools/call":
+                    if self._wants_sse(request):
+                        response = await self._handle_tools_call_sse(
+                            request, request_id, session, params
+                        )
+                        return response
+                    result = await self._handle_tools_call(
+                        request, session, params
                     )
-                result = await self._handle_tools_call(request, session, params)
-            elif method == "prompts/list":
-                result = {"prompts": []}
-            elif method == "resources/list":
-                result = {"resources": []}
-            else:
-                raise mcp.MCPError(
-                    mcp.METHOD_NOT_FOUND, f"method not found: {method}"
-                )
+                elif method == "prompts/list":
+                    result = {"prompts": []}
+                elif method == "resources/list":
+                    result = {"resources": []}
+                else:
+                    raise mcp.MCPError(
+                        mcp.METHOD_NOT_FOUND, f"method not found: {method}"
+                    )
             self.metrics.observe_rpc(method, "ok")
             response = web.json_response(mcp.make_response(request_id, result))
         except mcp.MCPError as exc:
@@ -163,6 +174,7 @@ class MCPHandler:
                 )
             )
         response.headers[SESSION_HEADER] = session.id
+        response.headers[TRACE_RESPONSE_HEADER] = trace_id
         return response
 
     # ------------------------------------------------------------------
@@ -188,7 +200,7 @@ class MCPHandler:
         params: Any,
     ) -> dict[str, Any]:
         tool_name, arguments = self.validator.validate_tool_call_params(params)
-        headers = self.header_filter.to_grpc_metadata(session.headers)
+        headers = self._metadata_with_trace(session)
         start = time.perf_counter()
         try:
             method = self.discoverer.get_method_by_tool(tool_name)
@@ -265,13 +277,14 @@ class MCPHandler:
         """Stream tool output incrementally as SSE events; the final
         event carries the complete JSON-RPC response."""
         tool_name, arguments = self.validator.validate_tool_call_params(params)
-        headers = self.header_filter.to_grpc_metadata(session.headers)
+        headers = self._metadata_with_trace(session)
         response = web.StreamResponse(
             status=200,
             headers={
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 SESSION_HEADER: session.id,
+                TRACE_RESPONSE_HEADER: tracing.tracer.current_trace_id(),
             },
         )
         await response.prepare(request)
@@ -365,9 +378,32 @@ class MCPHandler:
         stats["sessions"] = self.sessions.stats()
         return web.json_response(stats)
 
+    async def handle_traces(self, request: web.Request) -> web.Response:
+        """GET /debug/traces: recent per-call spans, newest first
+        (SURVEY.md §5.1 — the reference had durations in logs only)."""
+        try:
+            n = int(request.query.get("n", "100"))
+        except ValueError:
+            n = 100
+        return web.json_response(
+            {"spans": tracing.tracer.recent(max(1, min(n, 512)))}
+        )
+
     # ------------------------------------------------------------------
     # helpers
     # ------------------------------------------------------------------
+
+    def _metadata_with_trace(self, session: SessionContext) -> list[tuple[str, str]]:
+        """Forwarded session headers + the current trace id as
+        x-trace-id metadata (the gateway's own id wins over any stale
+        client-supplied header so one id stitches the whole call)."""
+        headers = self.header_filter.to_grpc_metadata(session.headers)
+        trace_id = tracing.tracer.current_trace_id()
+        if trace_id:
+            headers = [
+                (k, v) for k, v in headers if k != tracing.TRACE_HEADER
+            ] + [(tracing.TRACE_HEADER, trace_id)]
+        return headers
 
     def _session_for(self, request: web.Request) -> SessionContext:
         """Resolve/mint the session from Mcp-Session-Id; ALL header

@@ -33,6 +33,7 @@ from ggrmcp_tpu.serving import tensors
 from ggrmcp_tpu.serving.batching import ContinuousBatcher
 from ggrmcp_tpu.serving.engine import EmbeddingEngine, GenerationEngine
 from ggrmcp_tpu.serving.tokenizer import load_tokenizer
+from ggrmcp_tpu.utils import tracing
 
 logger = logging.getLogger("ggrmcp.serving.sidecar")
 
@@ -70,6 +71,7 @@ class Sidecar:
         self.server: Optional[grpc.aio.Server] = None
         self.health = HealthService()
         self.port = 0
+        self._profile_lock = asyncio.Lock()
 
     # ------------------------------------------------------------------
     # EmbedService
@@ -103,12 +105,19 @@ class Sidecar:
                 f"unknown pooling {pooling!r}",
             )
         loop = asyncio.get_running_loop()
-        vectors = await loop.run_in_executor(
-            None,
-            lambda: self.embedding.embed(
-                token_lists, pooling, request.max_length
-            ),
-        )
+        with tracing.tracer.span(
+            "sidecar.embed",
+            trace_id=tracing.trace_id_from_metadata(
+                context.invocation_metadata()
+            ) or None,
+            model=self.embedding.cfg.name, batch=len(token_lists),
+        ):
+            vectors = await loop.run_in_executor(
+                None,
+                lambda: self.embedding.embed(
+                    token_lists, pooling, request.max_length
+                ),
+            )
         return serving_pb2.EmbedResponse(
             embeddings=tensors.to_proto(vectors),
             model_id=self.embedding.cfg.name,
@@ -146,12 +155,20 @@ class Sidecar:
         seed = request.sampling.seed or 0
         token_ids: list[int] = []
         finish = "length"
-        async for chunk_ids, reason in self.batcher.submit(
-            prompt, max_new, self._sampling(request), seed
-        ):
-            token_ids.extend(chunk_ids)
-            if reason:
-                finish = reason
+        with tracing.tracer.span(
+            "sidecar.generate",
+            trace_id=tracing.trace_id_from_metadata(
+                context.invocation_metadata()
+            ) or None,
+            model=self.generation.cfg.name, prompt_tokens=len(prompt),
+        ) as span:
+            async for chunk_ids, reason in self.batcher.submit(
+                prompt, max_new, self._sampling(request), seed
+            ):
+                token_ids.extend(chunk_ids)
+                if reason:
+                    finish = reason
+            span.set(completion_tokens=len(token_ids), finish=finish)
         if finish == "error":
             await context.abort(
                 grpc.StatusCode.INTERNAL, "generation failed on the backend"
@@ -239,6 +256,36 @@ class Sidecar:
         )
 
     # ------------------------------------------------------------------
+    # DebugService — on-demand JAX profiler capture (SURVEY.md §5.1)
+    # ------------------------------------------------------------------
+
+    async def profile(self, request: serving_pb2.ProfileRequest, context):
+        duration_ms = min(request.duration_ms or 1000, 60_000)
+        if self._profile_lock.locked():
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "a profile capture is already running",
+            )
+        async with self._profile_lock:
+            loop = asyncio.get_running_loop()
+            try:
+                path = await loop.run_in_executor(
+                    None,
+                    lambda: tracing.profile_capture(
+                        duration_ms, request.output_dir or None
+                    ),
+                )
+            except Exception as exc:
+                logger.exception("profile capture failed")
+                await context.abort(
+                    grpc.StatusCode.INTERNAL, f"profile capture failed: {exc}"
+                )
+        logger.info("profiler capture (%.0f ms) written to %s", duration_ms, path)
+        return serving_pb2.ProfileResponse(
+            output_path=path, duration_ms=duration_ms
+        )
+
+    # ------------------------------------------------------------------
     # Server lifecycle
     # ------------------------------------------------------------------
 
@@ -279,6 +326,14 @@ class Sidecar:
             {"GetModelInfo": MethodDef(
                 self.get_model_info,
                 serving_pb2.ModelInfoRequest, serving_pb2.ModelInfoResponse,
+            )},
+        )
+        services.append("ggrmcp.tpu.DebugService")
+        add_service(
+            self.server, "ggrmcp.tpu.DebugService",
+            {"Profile": MethodDef(
+                self.profile,
+                serving_pb2.ProfileRequest, serving_pb2.ProfileResponse,
             )},
         )
         ReflectionService(services).attach(self.server)

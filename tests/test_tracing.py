@@ -1,0 +1,132 @@
+"""Tracing subsystem tests: span mechanics, trace id propagation
+gateway → backend → sidecar, /debug/traces, and the JAX profiler hook
+(SURVEY.md §5.1 — the reference logs durations only)."""
+
+import os
+
+import pytest
+
+from ggrmcp_tpu.utils import tracing
+from ggrmcp_tpu.utils.tracing import Tracer
+
+
+class TestTracer:
+    def test_span_records_duration_and_attrs(self):
+        t = Tracer()
+        with t.span("work", foo=1) as sp:
+            sp.set(bar=2)
+        spans = t.recent()
+        assert len(spans) == 1
+        assert spans[0]["name"] == "work"
+        assert spans[0]["attrs"] == {"foo": 1, "bar": 2}
+        assert spans[0]["durationMs"] >= 0
+
+    def test_child_inherits_trace_id_and_parent(self):
+        t = Tracer()
+        with t.span("outer", trace_id="abc123") as outer:
+            with t.span("inner"):
+                assert t.current_trace_id() == "abc123"
+        outer_rec, inner = t.recent()  # newest (outer finished last) first
+        assert inner["name"] == "inner"
+        assert inner["traceId"] == "abc123"
+        assert inner["parentId"] == outer.span_id
+        assert outer_rec["parentId"] == ""
+
+    def test_explicit_trace_id_breaks_parent_link(self):
+        t = Tracer()
+        with t.span("outer"):
+            with t.span("inner", trace_id="other-trace"):
+                pass
+        inner = t.recent()[1]  # [0] is outer, which finished last
+        assert inner["traceId"] == "other-trace"
+        assert inner["parentId"] == ""
+
+    def test_ring_buffer_bounded(self):
+        t = Tracer(capacity=4)
+        for i in range(10):
+            with t.span(f"s{i}"):
+                pass
+        spans = t.recent()
+        assert len(spans) == 4
+        assert spans[0]["name"] == "s9"  # newest first
+
+    def test_exception_marks_span(self):
+        t = Tracer()
+        with pytest.raises(ValueError):
+            with t.span("boom"):
+                raise ValueError("x")
+        assert t.recent()[0]["attrs"]["error"] == "ValueError"
+
+    def test_trace_id_from_metadata(self):
+        md = (("content-type", "x"), ("X-Trace-Id", "tid1"))
+        assert tracing.trace_id_from_metadata(md) == "tid1"
+        assert tracing.trace_id_from_metadata(()) == ""
+        assert tracing.trace_id_from_metadata(None) == ""
+
+
+class TestGatewayTracing:
+    async def test_trace_id_echoed_and_span_recorded(self):
+        from tests.test_gateway_http import gateway_env, rpc
+
+        tracing.tracer.clear()
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(
+                client, "tools/call",
+                {"name": "hello_helloservice_sayhello",
+                 "arguments": {"name": "T"}},
+                headers={"X-Trace-Id": "trace-gw-1"},
+            )
+            assert resp.headers["X-Trace-Id"] == "trace-gw-1"
+            traces = await (await client.get("/debug/traces")).json()
+        spans = [s for s in traces["spans"] if s["traceId"] == "trace-gw-1"]
+        assert spans and spans[0]["name"] == "gateway.tools/call"
+
+    async def test_server_generates_trace_id_when_absent(self):
+        from tests.test_gateway_http import gateway_env, rpc
+
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(client, "tools/list")
+            assert len(resp.headers["X-Trace-Id"]) == 16  # 8 random bytes hex
+
+
+class TestSidecarTracing:
+    async def test_sidecar_span_continues_gateway_trace(self):
+        import grpc.aio
+
+        from ggrmcp_tpu.rpc.pb import serving_pb2
+        from tests.test_serving import _unary, sidecar_env
+
+        tracing.tracer.clear()
+        async with sidecar_env() as (_, channel, _port):
+            gen = _unary(
+                channel, "/ggrmcp.tpu.GenerateService/Generate",
+                serving_pb2.GenerateRequest, serving_pb2.GenerateResponse,
+            )
+            await gen(
+                serving_pb2.GenerateRequest(prompt="hi", max_new_tokens=2),
+                metadata=(("x-trace-id", "trace-side-1"),),
+            )
+        spans = [
+            s for s in tracing.tracer.recent()
+            if s["name"] == "sidecar.generate"
+        ]
+        assert spans and spans[0]["traceId"] == "trace-side-1"
+        assert spans[0]["attrs"]["model"] == "tiny-llama"
+        assert spans[0]["attrs"]["completion_tokens"] >= 1
+
+    async def test_profile_rpc_captures_trace(self, tmp_path):
+        from ggrmcp_tpu.rpc.pb import serving_pb2
+        from tests.test_serving import _unary, sidecar_env
+
+        out = str(tmp_path / "prof")
+        async with sidecar_env() as (_, channel, _port):
+            prof = _unary(
+                channel, "/ggrmcp.tpu.DebugService/Profile",
+                serving_pb2.ProfileRequest, serving_pb2.ProfileResponse,
+            )
+            resp = await prof(
+                serving_pb2.ProfileRequest(duration_ms=50, output_dir=out)
+            )
+        assert resp.output_path == out
+        # The JAX profiler writes a plugins/profile/<ts>/ dump tree.
+        assert os.path.isdir(out) and os.listdir(out)
