@@ -44,10 +44,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="co-launch a JAX TPU serving sidecar and register it",
     )
     gw.add_argument("--model", default=None, help="sidecar model (with --tpu)")
+    gw.add_argument(
+        "--quantize", default=None, help="sidecar weight quantization (int8)"
+    )
 
     sc = sub.add_parser("sidecar", help="run the TPU serving sidecar only")
     sc.add_argument("--port", type=int, default=None, help="gRPC listen port")
     sc.add_argument("--model", default=None, help="model registry key")
+    sc.add_argument(
+        "--quantize", default=None, help="weight quantization (int8)"
+    )
     sc.add_argument("--config", default=None, help="YAML/JSON config file")
     sc.add_argument("--log-level", default=None)
 
@@ -73,6 +79,8 @@ def load_config(args: argparse.Namespace) -> cfgmod.Config:
         cfg.grpc.descriptor_set.path = args.descriptor
     if getattr(args, "model", None):
         cfg.serving.model = args.model
+    if getattr(args, "quantize", None):
+        cfg.serving.quantize = args.quantize
     if getattr(args, "port", None):
         cfg.serving.port = args.port
     cfg.validate()

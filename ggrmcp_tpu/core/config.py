@@ -274,6 +274,9 @@ class ServingConfig:
     checkpoint_path: str = ""
     # HuggingFace tokenizer.json path (empty → hermetic byte tokenizer).
     tokenizer_path: str = ""
+    # Weight quantization for decoder serving: "" (off) or "int8"
+    # (per-channel weight-only — halves HBM traffic on decode).
+    quantize: str = ""
 
 
 # ---------------------------------------------------------------------------
@@ -330,6 +333,13 @@ class Config:
             raise ValueError("schema depth must be positive")
         if self.grpc.descriptor_set.enabled and not self.grpc.descriptor_set.path:
             raise ValueError("descriptor set enabled but no path given")
+        if self.serving.quantize not in ("", "int8"):
+            # Catch typos at parse time, before minutes of checkpoint
+            # loading (the engine re-checks at apply time).
+            raise ValueError(
+                f"unknown serving.quantize {self.serving.quantize!r}; "
+                f"supported: 'int8'"
+            )
 
 
 def default() -> Config:

@@ -30,6 +30,8 @@ from jax.sharding import PartitionSpec as P
 
 from ggrmcp_tpu.models import common
 from ggrmcp_tpu.ops.attention import attention
+from ggrmcp_tpu.ops.quant import QuantizedArray, embed_lookup
+from ggrmcp_tpu.ops.quant import matmul as qmatmul
 from ggrmcp_tpu.ops.rope import apply_rope
 
 Params = common.Params
@@ -177,7 +179,7 @@ def attention_block(
     h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     normed = common.rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
-    qkv = normed @ layer_params["wqkv"]  # [B, S, (H+2KVH)*Dh]
+    qkv = qmatmul(normed, layer_params["wqkv"])  # [B, S, (H+2KVH)*Dh]
     q, kv = jnp.split(qkv, [h * hd], axis=-1)
     k, v = jnp.split(kv, 2, axis=-1)
     q = q.reshape(b, s, h, hd)
@@ -210,7 +212,7 @@ def attention_block(
     attn_out = attention(
         q, k_all, v_all, causal=True, q_offset=q_offset, kv_len=kv_len
     )
-    attn_out = attn_out.reshape(b, s, h * hd) @ layer_params["wo"]
+    attn_out = qmatmul(attn_out.reshape(b, s, h * hd), layer_params["wo"])
     x = x + attn_out
 
     if cache_k is not None:
@@ -233,9 +235,9 @@ def _layer(
 
     # SwiGLU MLP
     normed = common.rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(normed @ layer_params["w_gate"])
-    up = normed @ layer_params["w_up"]
-    x = x + (gate * up) @ layer_params["w_down"]
+    gate = jax.nn.silu(qmatmul(normed, layer_params["w_gate"]))
+    up = qmatmul(normed, layer_params["w_up"])
+    x = x + qmatmul(gate * up, layer_params["w_down"])
 
     return x, new_cache
 
@@ -254,7 +256,7 @@ def forward(
     Returns (logits [B, S, V], updated cache or None).
     """
     b, s = tokens.shape
-    x = params["embed"].astype(cfg.jnp_dtype)[tokens]  # [B, S, D]
+    x = embed_lookup(params["embed"], tokens, cfg.jnp_dtype)  # [B, S, D]
 
     if cache is not None:
         positions = cache.length[:, None] + jnp.arange(s)[None, :]
@@ -284,7 +286,10 @@ def forward(
         new_cache = KVCache(k=new_k, v=new_v, length=cache.length + s)
 
     x = common.rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x @ params["lm_head"].astype(cfg.jnp_dtype)  # [B, S, V]
+    head = params["lm_head"]
+    if not isinstance(head, QuantizedArray):
+        head = head.astype(cfg.jnp_dtype)
+    logits = qmatmul(x, head)  # [B, S, V]
     return logits.astype(jnp.float32), new_cache
 
 

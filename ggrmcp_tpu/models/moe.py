@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ggrmcp_tpu.models import common
+from ggrmcp_tpu.ops.quant import QuantizedArray, embed_lookup
+from ggrmcp_tpu.ops.quant import matmul as qmatmul
 # KV/activation layouts are identical to the dense family by design —
 # the engine treats both families interchangeably, so the specs are
 # re-exported rather than duplicated.
@@ -279,7 +281,7 @@ def forward_with_aux(
 ) -> tuple[jnp.ndarray, Optional[KVCache], jnp.ndarray]:
     """Forward returning the mean router load-balance loss (training)."""
     b, s = tokens.shape
-    x = params["embed"].astype(cfg.jnp_dtype)[tokens]
+    x = embed_lookup(params["embed"], tokens, cfg.jnp_dtype)
 
     if cache is not None:
         positions = cache.length[:, None] + jnp.arange(s)[None, :]
@@ -313,7 +315,10 @@ def forward_with_aux(
         new_cache = KVCache(k=new_k, v=new_v, length=cache.length + s)
 
     x = common.rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x @ params["lm_head"].astype(cfg.jnp_dtype)
+    head = params["lm_head"]
+    if not isinstance(head, QuantizedArray):
+        head = head.astype(cfg.jnp_dtype)
+    logits = qmatmul(x, head)
     return logits.astype(jnp.float32), new_cache, auxes.mean()
 
 

@@ -1,0 +1,139 @@
+"""Int8 weight-only quantization for serving.
+
+TPU decode is HBM-bandwidth-bound: every step streams the full weight
+set through the MXU, so halving weight bytes nearly halves step time at
+small batch (and halves the HBM a model needs — llama3-8b drops from
+~16 GB to ~8 GB, fitting smaller slices). The scheme is the standard
+serving one:
+
+- per-output-channel symmetric int8: `q = round(w / scale)` with
+  `scale = max|w| / 127` over the contraction axis — one scale per
+  output column, so accuracy loss is minimal (no activation quant).
+- dequantization happens INSIDE the matmul: `x @ q.astype(bf16) *
+  scale`. XLA fuses the cast and the column scale into the matmul
+  epilogue, so the MXU still sees a dense bf16 GEMM while HBM traffic
+  is int8.
+- embeddings quantize per-row (one scale per token vector) since they
+  are gathered, not contracted.
+
+No reference analogue (the Go gateway executes no models); this is a
+serving-plane component of the new framework (SURVEY.md §7 stage 6,
+throughput layer).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantizedArray(NamedTuple):
+    """A weight stored int8 with its dequantization scale. Registered
+    as a pytree (NamedTuple), so stacked [L, ...] quantized layers scan
+    and shard exactly like dense ones."""
+
+    q: jnp.ndarray  # int8, same shape as the original weight
+    scale: jnp.ndarray  # original dtype; quantization axis has size 1
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.scale.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return self.q.nbytes + self.scale.nbytes
+
+
+ArrayOrQuant = Union[jnp.ndarray, QuantizedArray]
+
+
+def quantize(w: jnp.ndarray, axis: int = -2) -> QuantizedArray:
+    """Symmetric int8 quantization with the scale reduced over `axis`
+    (default: the contraction axis of a [.., K, N] matmul weight →
+    per-output-channel scales)."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QuantizedArray(q=q, scale=scale.astype(w.dtype))
+
+
+def dequantize(qa: QuantizedArray) -> jnp.ndarray:
+    return qa.q.astype(qa.scale.dtype) * qa.scale
+
+
+def matmul(x: jnp.ndarray, w: ArrayOrQuant) -> jnp.ndarray:
+    """`x @ w` for dense or quantized weights. For QuantizedArray the
+    int8 weight is cast to the activation dtype in-register and the
+    per-column scale is applied to the product (fused by XLA)."""
+    if isinstance(w, QuantizedArray):
+        return (x @ w.q.astype(x.dtype)) * w.scale
+    return x @ w
+
+
+def embed_lookup(table: ArrayOrQuant, tokens: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Row gather from a dense or row-quantized [V, D] embedding."""
+    if isinstance(table, QuantizedArray):
+        return table.q[tokens].astype(dtype) * table.scale[tokens].astype(dtype)
+    return table.astype(dtype)[tokens]
+
+
+# ---------------------------------------------------------------------------
+# Whole-model transforms
+# ---------------------------------------------------------------------------
+
+# Decoder-family matmul weights quantized per-output-channel (the
+# contraction axis of the stacked [L, K, N] layout is -2). Only 3-D
+# stacked leaves qualify: MoE expert banks share these names but are
+# 4-D [L, E, ..] einsum weights and stay dense (their dispatch/combine
+# einsums are not routed through `matmul`).
+_LAYER_MATMULS = ("wqkv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _is_stacked_matmul(leaf) -> bool:
+    return getattr(leaf, "ndim", 0) == 3
+
+
+def quantize_model(params: dict[str, Any]) -> dict[str, Any]:
+    """Quantize a decoder-family param pytree for serving: layer
+    matmuls and lm_head per-output-channel, embedding per-row; norms
+    (and MoE expert banks) stay float. jit-able (use out_shardings to
+    quantize in place on the mesh)."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in _LAYER_MATMULS:
+        if name in layers and _is_stacked_matmul(layers[name]):
+            layers[name] = quantize(layers[name], axis=-2)
+    out["layers"] = layers
+    if "lm_head" in out:
+        out["lm_head"] = quantize(params["lm_head"], axis=-2)
+    if "embed" in out:
+        out["embed"] = quantize(params["embed"], axis=-1)
+    return out
+
+
+def quantize_specs(specs: dict[str, Any]) -> dict[str, Any]:
+    """Mirror `quantize_model` over a PartitionSpec tree: each
+    quantized leaf's spec applies to both q and scale (the scale's
+    size-1 axis is dropped by `compatible_spec` downstream). Specs are
+    not shape-aware, so 3-D-ness is keyed off the spec length."""
+    out = dict(specs)
+    layers = dict(specs["layers"])
+    for name in _LAYER_MATMULS:
+        if name in layers and len(tuple(layers[name])) == 3:
+            layers[name] = QuantizedArray(q=layers[name], scale=layers[name])
+    out["layers"] = layers
+    for name in ("lm_head", "embed"):
+        if name in out:
+            out[name] = QuantizedArray(q=out[name], scale=out[name])
+    return out
+
+
+def quantized_nbytes(params: dict[str, Any]) -> int:
+    return sum(x.nbytes for x in jax.tree_util.tree_leaves(params))

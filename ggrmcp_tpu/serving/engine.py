@@ -129,6 +129,8 @@ class GenerationEngine:
             )
         else:
             params = _shard_params(params, self.fam.param_specs(cfg), self.mesh)
+        if self.serving.quantize:
+            params = self._quantize_params(params)
         self.params = params
         self._prefill_fn = jax.jit(
             self._prefill_impl, donate_argnums=(2,), static_argnums=()
@@ -141,6 +143,33 @@ class GenerationEngine:
         self._generate_fn = jax.jit(
             self._generate_impl, static_argnums=(2, 3)
         )
+
+    def _quantize_params(self, params):
+        """Int8 weight-only quantization, applied on-mesh (the transform
+        runs under jit with quantized out-shardings, so full-precision
+        weights never round-trip through the host)."""
+        from ggrmcp_tpu.ops import quant
+
+        if self.serving.quantize != "int8":
+            raise ValueError(
+                f"unknown quantize mode {self.serving.quantize!r}"
+            )
+        qspecs = quant.quantize_specs(self.fam.param_specs(self.cfg))
+        shapes = jax.eval_shape(quant.quantize_model, params)
+        qspecs = _adapt_specs(qspecs, shapes, self.mesh)
+        before = quant.quantized_nbytes(params)
+        with self.mesh:
+            params = jax.jit(
+                quant.quantize_model,
+                out_shardings=jax.tree_util.tree_map(
+                    lambda s: NamedSharding(self.mesh, s), qspecs
+                ),
+            )(params)
+        logger.info(
+            "quantized %s to int8: %.1f → %.1f MB of weights",
+            self.cfg.name, before / 1e6, quant.quantized_nbytes(params) / 1e6,
+        )
+        return params
 
     # -- jitted bodies ------------------------------------------------------
 

@@ -12,6 +12,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import re
+import tempfile
 import time
 from typing import Optional
 
@@ -260,20 +263,30 @@ class Sidecar:
     # ------------------------------------------------------------------
 
     async def profile(self, request: serving_pb2.ProfileRequest, context):
-        duration_ms = min(request.duration_ms or 1000, 60_000)
+        # The client names the dump, it does not place it: output_dir is
+        # reduced to a [A-Za-z0-9._-] label under the server-side base
+        # dir, so remote callers can never write outside it.
+        duration_ms = (
+            1000.0 if not request.duration_ms
+            else float(min(max(request.duration_ms, 10), 60_000))
+        )
+        label = re.sub(r"[^A-Za-z0-9._-]", "_", os.path.basename(
+            request.output_dir or ""
+        )) or f"capture-{int(time.time())}"
+        out = os.path.join(
+            tempfile.gettempdir(), "ggrmcp-profiles", label
+        )
         if self._profile_lock.locked():
             await context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION,
                 "a profile capture is already running",
             )
         async with self._profile_lock:
+            os.makedirs(out, exist_ok=True)
             loop = asyncio.get_running_loop()
             try:
                 path = await loop.run_in_executor(
-                    None,
-                    lambda: tracing.profile_capture(
-                        duration_ms, request.output_dir or None
-                    ),
+                    None, lambda: tracing.profile_capture(duration_ms, out)
                 )
             except Exception as exc:
                 logger.exception("profile capture failed")

@@ -1,0 +1,128 @@
+"""Int8 weight-only quantization: numerics, model-level transform,
+quantized serving engine (single-chip and TP-sharded)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.core.config import BatchingConfig, MeshConfig, ServingConfig
+from ggrmcp_tpu.models import llama
+from ggrmcp_tpu.ops import quant
+from ggrmcp_tpu.ops.sampling import SamplingConfig
+from ggrmcp_tpu.serving.engine import GenerationEngine
+
+CFG = llama.CONFIGS["tiny-llama"]
+
+
+class TestQuantOps:
+    def test_roundtrip_error_small(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+        qa = quant.quantize(w)
+        err = jnp.abs(quant.dequantize(qa) - w)
+        # Per-channel int8: max error is scale/2 = max|w|/254 per column.
+        col_max = jnp.max(jnp.abs(w), axis=-2)
+        assert float(jnp.max(err / col_max)) < 1 / 127
+
+    def test_matmul_close_to_dense(self):
+        key = jax.random.PRNGKey(1)
+        x = jax.random.normal(key, (4, 64), jnp.float32)
+        w = jax.random.normal(jax.random.fold_in(key, 1), (64, 32))
+        ref = x @ w
+        got = quant.matmul(x, quant.quantize(w))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=0.05, atol=0.05 * float(jnp.abs(ref).max()))
+
+    def test_matmul_dense_passthrough(self):
+        x = jnp.ones((2, 3))
+        w = jnp.ones((3, 4))
+        np.testing.assert_allclose(quant.matmul(x, w), x @ w)
+
+    def test_embed_lookup_row_quantized(self):
+        table = jax.random.normal(jax.random.PRNGKey(2), (16, 8), jnp.float32)
+        qa = quant.quantize(table, axis=-1)
+        tokens = jnp.asarray([[0, 3, 15]])
+        ref = table[tokens]
+        got = quant.embed_lookup(qa, tokens, jnp.float32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=0.02, atol=0.02)
+
+    def test_quantize_model_halves_bytes_and_skips_norms(self):
+        params = llama.init_params(jax.random.PRNGKey(0), CFG)
+        qparams = quant.quantize_model(params)
+        assert isinstance(qparams["layers"]["wqkv"], quant.QuantizedArray)
+        assert qparams["layers"]["wqkv"].q.dtype == jnp.int8
+        assert not isinstance(qparams["layers"]["attn_norm"],
+                              quant.QuantizedArray)
+        # tiny-llama is float32, so int8 cuts matmul weights ~4x.
+        assert quant.quantized_nbytes(qparams) < (
+            0.5 * quant.quantized_nbytes(params)
+        )
+
+    def test_moe_expert_banks_stay_dense(self):
+        from ggrmcp_tpu.models import moe
+
+        cfg = moe.CONFIGS["tiny-moe"]
+        params = moe.init_params(jax.random.PRNGKey(0), cfg)
+        qparams = quant.quantize_model(params)
+        assert not isinstance(qparams["layers"]["w_gate"],
+                              quant.QuantizedArray)  # 4-D einsum bank
+        assert isinstance(qparams["layers"]["wqkv"], quant.QuantizedArray)
+
+
+class TestQuantizedForward:
+    def test_logits_close_to_dense(self):
+        params = llama.init_params(jax.random.PRNGKey(0), CFG)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(5), (2, 16), 0, CFG.vocab_size
+        ).astype(jnp.int32)
+        ref, _ = llama.forward(params, CFG, tokens)
+        got, _ = llama.forward(quant.quantize_model(params), CFG, tokens)
+        ref, got = np.asarray(ref), np.asarray(got)
+        cos = np.sum(ref * got, -1) / (
+            np.linalg.norm(ref, axis=-1) * np.linalg.norm(got, axis=-1)
+        )
+        assert cos.min() > 0.999
+        # Greedy decisions should essentially always agree.
+        agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+        assert agree > 0.95
+
+
+class TestQuantizedEngine:
+    def _engine(self, mesh_cfg) -> GenerationEngine:
+        return GenerationEngine(
+            CFG,
+            ServingConfig(
+                mesh=mesh_cfg,
+                batching=BatchingConfig(max_batch_size=2, kv_cache_max_seq=128),
+                quantize="int8",
+            ),
+        )
+
+    def test_generates_deterministically(self):
+        engine = self._engine(MeshConfig(tensor=1, data=0))
+        outs1, reasons = engine.generate(
+            [[5, 6, 7]], max_new_tokens=6, sampling=SamplingConfig(), seed=0
+        )
+        outs2, _ = engine.generate(
+            [[5, 6, 7]], max_new_tokens=6, sampling=SamplingConfig(), seed=0
+        )
+        assert outs1 == outs2 and len(outs1[0]) >= 1
+        assert reasons[0] in ("stop", "length")
+
+    def test_tp_sharded_quantized_engine(self):
+        engine = self._engine(MeshConfig(tensor=2, data=0))
+        outs, _ = engine.generate(
+            [[1, 2, 3], [4, 5, 6]], max_new_tokens=4,
+            sampling=SamplingConfig(), seed=1,
+        )
+        assert len(outs) == 2
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown quantize mode"):
+            GenerationEngine(
+                CFG,
+                ServingConfig(
+                    mesh=MeshConfig(tensor=1, data=0), quantize="fp4"
+                ),
+            )

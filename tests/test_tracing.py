@@ -3,6 +3,7 @@ gateway → backend → sidecar, /debug/traces, and the JAX profiler hook
 (SURVEY.md §5.1 — the reference logs durations only)."""
 
 import os
+import tempfile
 
 import pytest
 
@@ -114,19 +115,36 @@ class TestSidecarTracing:
         assert spans[0]["attrs"]["model"] == "tiny-llama"
         assert spans[0]["attrs"]["completion_tokens"] >= 1
 
-    async def test_profile_rpc_captures_trace(self, tmp_path):
+    async def test_profile_rpc_captures_trace(self):
         from ggrmcp_tpu.rpc.pb import serving_pb2
         from tests.test_serving import _unary, sidecar_env
 
-        out = str(tmp_path / "prof")
         async with sidecar_env() as (_, channel, _port):
             prof = _unary(
                 channel, "/ggrmcp.tpu.DebugService/Profile",
                 serving_pb2.ProfileRequest, serving_pb2.ProfileResponse,
             )
+            # output_dir is a label, not a path: traversal attempts are
+            # flattened to a name under the server's profile base.
             resp = await prof(
-                serving_pb2.ProfileRequest(duration_ms=50, output_dir=out)
+                serving_pb2.ProfileRequest(
+                    duration_ms=50, output_dir="../../etc/evil"
+                )
             )
-        assert resp.output_path == out
+        base = os.path.join(tempfile.gettempdir(), "ggrmcp-profiles")
+        assert os.path.dirname(resp.output_path) == base
+        assert os.path.basename(resp.output_path) == "evil"
         # The JAX profiler writes a plugins/profile/<ts>/ dump tree.
-        assert os.path.isdir(out) and os.listdir(out)
+        assert os.path.isdir(resp.output_path) and os.listdir(resp.output_path)
+
+    async def test_profile_rpc_clamps_duration(self):
+        from ggrmcp_tpu.rpc.pb import serving_pb2
+        from tests.test_serving import _unary, sidecar_env
+
+        async with sidecar_env() as (_, channel, _port):
+            prof = _unary(
+                channel, "/ggrmcp.tpu.DebugService/Profile",
+                serving_pb2.ProfileRequest, serving_pb2.ProfileResponse,
+            )
+            resp = await prof(serving_pb2.ProfileRequest(duration_ms=-500))
+        assert resp.duration_ms == 10  # clamped to the floor, never negative
