@@ -261,6 +261,14 @@ class BatchingConfig:
     max_decode_steps: int = 512
     prefill_chunk: int = 512
     kv_cache_max_seq: int = 4096
+    # Decode steps fused into one device call (lax.scan): k× fewer
+    # host↔device round-trips per generated token — the dominant cost
+    # when the TPU is reached over a network link. Streaming chunks and
+    # new-request admission are quantized to this many tokens, and up
+    # to k-1 sampled tokens per request are discarded at EOS/max_new,
+    # so keep it small; 1 = the classic one-call-per-token loop (best
+    # for CPU test meshes, where compute dominates the round-trip).
+    decode_steps_per_tick: int = 1
 
 
 @dataclass
@@ -333,6 +341,8 @@ class Config:
             raise ValueError("schema depth must be positive")
         if self.grpc.descriptor_set.enabled and not self.grpc.descriptor_set.path:
             raise ValueError("descriptor set enabled but no path given")
+        if self.serving.batching.decode_steps_per_tick < 1:
+            raise ValueError("decode_steps_per_tick must be >= 1")
         if self.serving.quantize not in ("", "int8"):
             # Catch typos at parse time, before minutes of checkpoint
             # loading (the engine re-checks at apply time).

@@ -235,17 +235,20 @@ class MCPHandler:
             raise mcp.MCPError(
                 mcp.INVALID_PARAMS, sanitize_error(f"invalid arguments: {exc}")
             )
-        except grpc.aio.AioRpcError as exc:
+        except (grpc.RpcError, grpc.aio.UsageError) as exc:
             # Backend failure → IsError result, NOT a protocol error
-            # (handler.go:252-259 behavior, carried over).
+            # (handler.go:252-259 behavior, carried over). UsageError
+            # covers invoking over a channel the reconnect watchdog
+            # closed between routing and the call.
             self.metrics.observe_tool_call(
                 tool_name, "backend_error", time.perf_counter() - start
             )
-            message = sanitize_error(
-                f"gRPC call failed ({exc.code().name}): {exc.details()}"
-            )
+            if isinstance(exc, grpc.aio.AioRpcError):
+                message = f"gRPC call failed ({exc.code().name}): {exc.details()}"
+            else:
+                message = f"gRPC call failed: {exc}"
             session.increment_calls()
-            return mcp.tool_call_error(message)
+            return mcp.tool_call_error(sanitize_error(message))
         except (ConnectionError, asyncio.TimeoutError) as exc:
             self.metrics.observe_tool_call(
                 tool_name, "unavailable", time.perf_counter() - start
@@ -310,15 +313,22 @@ class MCPHandler:
             final = mcp.make_error_response(
                 request_id, mcp.METHOD_NOT_FOUND, f"tool not found: {tool_name}"
             )
-        except grpc.aio.AioRpcError as exc:
+        except (ConnectionResetError, ConnectionAbortedError):
+            # The SSE *client* went away mid-stream (a write inside the
+            # try raised) — not a backend failure; nothing left to write.
+            session.increment_calls()
+            self.metrics.observe_tool_call(
+                tool_name, "client_disconnect", time.perf_counter() - start
+            )
+            return response
+        except (grpc.RpcError, grpc.aio.UsageError, ConnectionError) as exc:
             outcome = "backend_error"
+            if isinstance(exc, grpc.aio.AioRpcError):
+                message = f"gRPC call failed ({exc.code().name}): {exc.details()}"
+            else:
+                message = f"gRPC call failed: {exc}"
             final = mcp.make_response(
-                request_id,
-                mcp.tool_call_error(
-                    sanitize_error(
-                        f"gRPC call failed ({exc.code().name}): {exc.details()}"
-                    )
-                ),
+                request_id, mcp.tool_call_error(sanitize_error(message))
             )
         except Exception as exc:
             outcome = "internal_error"
@@ -329,8 +339,11 @@ class MCPHandler:
         self.metrics.observe_tool_call(
             tool_name, outcome, time.perf_counter() - start
         )
-        await self._sse_event(response, "result", final)
-        await response.write_eof()
+        try:
+            await self._sse_event(response, "result", final)
+            await response.write_eof()
+        except (ConnectionResetError, ConnectionAbortedError):
+            pass  # client vanished before the final event
         return response
 
     @staticmethod

@@ -71,6 +71,7 @@ class ContinuousBatcher:
         self._stopping = False
 
         b = self.cfg.max_batch_size
+        self._steps_per_tick = max(1, self.cfg.decode_steps_per_tick)
         s_max = min(self.cfg.kv_cache_max_seq, engine.cfg.max_seq_len)
         self.max_seq = s_max
         self.cache = engine.make_cache(b, s_max)
@@ -106,17 +107,30 @@ class ContinuousBatcher:
         return self.fam.forward(params, self.engine.cfg, tokens, cache)
 
     def _tick_impl(self, tokens, cache, seeds, step, temps, ks, ps, active):
-        if self._is_moe:
-            logits, cache = self.fam.forward(
-                self.engine.params, self.engine.cfg, tokens[:, None], cache,
-                valid=active[:, None],
-            )
-        else:
-            logits, cache = self.fam.forward(
-                self.engine.params, self.engine.cfg, tokens[:, None], cache
-            )
-        nxt = sample_dynamic(logits[:, -1], seeds, step, temps, ks, ps)
-        return nxt, cache
+        """One device call = `decode_steps_per_tick` fused decode steps
+        (lax.scan). Fewer host round-trips per token: tokens sampled
+        after a slot's EOS/max_new are dropped host-side in
+        `_emit_chunk` (the cache rows they touched are masked by
+        `length` on slot reuse)."""
+
+        def body(carry, i):
+            cur, cache = carry
+            if self._is_moe:
+                logits, cache = self.fam.forward(
+                    self.engine.params, self.engine.cfg, cur[:, None], cache,
+                    valid=active[:, None],
+                )
+            else:
+                logits, cache = self.fam.forward(
+                    self.engine.params, self.engine.cfg, cur[:, None], cache
+                )
+            nxt = sample_dynamic(logits[:, -1], seeds, step + i, temps, ks, ps)
+            return (nxt, cache), nxt
+
+        (_, cache), toks = jax.lax.scan(
+            body, (tokens, cache), jnp.arange(self._steps_per_tick)
+        )
+        return toks.T, cache  # [B, steps_per_tick]
 
     def _insert_impl(self, cache, rows_k, rows_v, slot, length):
         """Scatter [L,1,S,KVH,Dh] prefill rows into the shared cache at
@@ -158,7 +172,12 @@ class ContinuousBatcher:
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         """Enqueue a request; yields (token_ids_chunk, finish_reason)
         pairs; finish_reason is set on the final chunk."""
-        prompt, max_new = fit_request(prompt, max_new, self.max_seq)
+        # Reserve steps_per_tick-1 cache slots: a tick may overshoot a
+        # slot's max_new by up to that many positions before the host
+        # masks the extra tokens.
+        prompt, max_new = fit_request(
+            prompt, max_new, self.max_seq - (self._steps_per_tick - 1)
+        )
         request = _Request(
             prompt=prompt, max_new=max_new, sampling=sampling, seed=seed
         )
@@ -297,40 +316,46 @@ class ContinuousBatcher:
         self._emit(slot_idx, first_tok)
 
     def _tick_sync(self) -> None:
-        self.step_counter += 1
+        step0 = self.step_counter
+        self.step_counter += self._steps_per_tick
         active = np.array([s.active for s in self.slots], bool)
-        nxt, self.cache = self._tick(
+        toks, self.cache = self._tick(
             jnp.asarray(self.cur_tokens), self.cache,
-            jnp.asarray(self.seeds), jnp.int32(self.step_counter),
+            jnp.asarray(self.seeds), jnp.int32(step0 + 1),
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
             jnp.asarray(self.top_ps), jnp.asarray(active),
         )
-        nxt = np.asarray(nxt)
+        toks = np.asarray(toks)  # [B, steps_per_tick]
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
-            self.cur_tokens[i] = nxt[i]
-            self._emit(i, int(nxt[i]))
+            self.cur_tokens[i] = toks[i, -1]
+            self._emit_chunk(i, toks[i])
 
-    def _emit(self, slot_idx: int, token: int) -> None:
+    def _emit_chunk(self, slot_idx: int, tokens) -> None:
+        """Deliver a tick's tokens for one slot: truncate at EOS or the
+        slot's max_new budget, finish the slot if either was hit."""
         slot = self.slots[slot_idx]
         request = slot.request
         if request is None:
             return
         finished_reason = None
-        if token == self.eos_id:
-            finished_reason = "stop"
-            ids: list[int] = []
-        else:
+        ids: list[int] = []
+        for token in tokens:
+            token = int(token)
+            if token == self.eos_id:
+                finished_reason = "stop"
+                break
+            ids.append(token)
             slot.generated += 1
-            ids = [token]
             if slot.generated >= slot.max_new:
                 finished_reason = "length"
+                break
         if request.cancelled:
             finished_reason = finished_reason or "cancelled"
             ids = []
-        # _emit runs on executor threads; asyncio.Queue is not
-        # thread-safe, so hop through the loop.
+        # Runs on executor threads; asyncio.Queue is not thread-safe,
+        # so hop through the loop.
         self._loop_ref.call_soon_threadsafe(
             request.out.put_nowait, (ids, finished_reason)
         )
@@ -340,3 +365,6 @@ class ContinuousBatcher:
             # Park the slot: freeze its row so it stops influencing
             # shared state (cache row stays, masked by length on reuse).
             self.temps[slot_idx] = 0.0
+
+    def _emit(self, slot_idx: int, token: int) -> None:
+        self._emit_chunk(slot_idx, [token])

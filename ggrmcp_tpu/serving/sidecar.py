@@ -272,7 +272,9 @@ class Sidecar:
         )
         label = re.sub(r"[^A-Za-z0-9._-]", "_", os.path.basename(
             request.output_dir or ""
-        )) or f"capture-{int(time.time())}"
+        ))
+        if not label.strip("."):  # "", "." and ".." all escape the base dir
+            label = f"capture-{int(time.time())}"
         out = os.path.join(
             tempfile.gettempdir(), "ggrmcp-profiles", label
         )

@@ -226,6 +226,85 @@ async def sidecar_env(model="tiny-llama"):
         await side.stop()
 
 
+class TestFusedDecodeTicks:
+    """decode_steps_per_tick > 1: same tokens as the per-step loop for
+    greedy decoding, correct truncation at non-multiple max_new."""
+
+    async def _collect(self, batcher, prompt, max_new, seed=0):
+        out: list[int] = []
+        reason = None
+        async for ids, reason in batcher.submit(
+            prompt, max_new, SamplingConfig(temperature=0.0), seed=seed
+        ):
+            out.extend(ids)
+        return out, reason
+
+    async def test_greedy_matches_per_step_loop(self, gen_engine):
+        from ggrmcp_tpu.serving.batching import ContinuousBatcher
+
+        prompt = [3, 1, 4, 1, 5]
+        results = {}
+        for steps in (1, 4):
+            batcher = ContinuousBatcher(
+                gen_engine,
+                BatchingConfig(
+                    max_batch_size=4, kv_cache_max_seq=256,
+                    decode_steps_per_tick=steps,
+                ),
+            )
+            batcher.start()
+            try:
+                results[steps] = await self._collect(batcher, prompt, 8)
+            finally:
+                await batcher.stop()
+        assert results[1] == results[4]
+
+    async def test_max_new_not_multiple_of_tick(self, gen_engine):
+        from ggrmcp_tpu.serving.batching import ContinuousBatcher
+
+        batcher = ContinuousBatcher(
+            gen_engine,
+            BatchingConfig(
+                max_batch_size=4, kv_cache_max_seq=256,
+                decode_steps_per_tick=4,
+            ),
+        )
+        batcher.start()
+        try:
+            out, reason = await self._collect(batcher, [3, 1, 4], 5)
+            assert reason in ("length", "stop")
+            if reason == "length":
+                assert len(out) == 5
+            else:
+                assert len(out) <= 5
+        finally:
+            await batcher.stop()
+
+    async def test_concurrent_requests_chunked(self, gen_engine):
+        from ggrmcp_tpu.serving.batching import ContinuousBatcher
+
+        batcher = ContinuousBatcher(
+            gen_engine,
+            BatchingConfig(
+                max_batch_size=4, kv_cache_max_seq=256,
+                decode_steps_per_tick=4,
+            ),
+        )
+        batcher.start()
+        try:
+            outs = await asyncio.gather(
+                *(
+                    self._collect(batcher, [2 + i, 7, 1], 6, seed=i)
+                    for i in range(6)  # > max_batch_size → queueing
+                )
+            )
+            for out, reason in outs:
+                assert reason in ("length", "stop")
+                assert len(out) <= 6
+        finally:
+            await batcher.stop()
+
+
 class TestBatcherRecovery:
     async def test_tick_failure_fails_request_then_recovers(self, gen_engine):
         """A decode-tick crash fails in-flight requests with 'error' but
