@@ -5,7 +5,7 @@ PROTOC ?= protoc
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: proto descriptors test test-fast bench-cpu smoke clean
+.PHONY: proto descriptors test test-fast bench-cpu smoke e2e lint clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
 proto:
@@ -32,6 +32,15 @@ bench-cpu:
 # End-to-end smoke: graft entry + multichip dry run on the CPU mesh.
 smoke:
 	$(CPU_ENV) $(PY) __graft_entry__.py
+
+# Real processes + curl through the live MCP surface (CI parity).
+e2e:
+	./scripts/e2e_smoke.sh
+
+# ruff if present (baked CI image installs it; the TPU image may not).
+lint:
+	@command -v ruff >/dev/null 2>&1 && ruff check ggrmcp_tpu tests bench.py \
+	  || echo "ruff not installed; skipping"
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

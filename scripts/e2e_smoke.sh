@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# End-to-end smoke test: real processes, real HTTP — the reference's CI
+# integration job (ci.yml:149-210) rebuilt for this stack. Launches the
+# example gRPC backend and the gateway, then curls the full MCP surface.
+set -euo pipefail
+
+GRPC_PORT="${GRPC_PORT:-56051}"
+HTTP_PORT="${HTTP_PORT:-56053}"
+BASE="http://localhost:${HTTP_PORT}"
+PIDS=()
+
+cleanup() {
+  for pid in "${PIDS[@]:-}"; do kill "$pid" 2>/dev/null || true; done
+}
+trap cleanup EXIT
+
+fail() { echo "FAIL: $*" >&2; exit 1; }
+
+echo "== starting hello backend on :${GRPC_PORT}"
+python examples/hello_server.py --port "${GRPC_PORT}" &
+PIDS+=($!)
+sleep 2
+
+echo "== starting gateway on :${HTTP_PORT}"
+python -m ggrmcp_tpu gateway --grpc-host localhost --grpc-port "${GRPC_PORT}" \
+  --http-port "${HTTP_PORT}" --dev &
+PIDS+=($!)
+
+for _ in $(seq 1 30); do
+  curl -sf "${BASE}/health" >/dev/null 2>&1 && break
+  sleep 1
+done
+
+echo "== GET /health"
+curl -sf "${BASE}/health" | grep -q '"status": "healthy"' || fail "health not healthy"
+
+echo "== GET / (initialize)"
+curl -sf "${BASE}/" | grep -q '"protocolVersion"' || fail "initialize missing protocolVersion"
+
+echo "== tools/list"
+LIST=$(curl -sf -X POST "${BASE}/" -H 'Content-Type: application/json' \
+  -d '{"jsonrpc":"2.0","method":"tools/list","id":1}')
+echo "$LIST" | grep -q 'hello_helloservice_sayhello' || fail "tool missing from tools/list"
+echo "$LIST" | grep -q '"inputSchema"' || fail "inputSchema missing"
+
+echo "== tools/call"
+CALL=$(curl -sf -X POST "${BASE}/" -H 'Content-Type: application/json' \
+  -d '{"jsonrpc":"2.0","method":"tools/call","id":2,"params":{"name":"hello_helloservice_sayhello","arguments":{"name":"CI"}}}')
+echo "$CALL" | grep -q 'Hello, CI!' || fail "tools/call wrong payload: $CALL"
+
+echo "== error paths"
+curl -s -X POST "${BASE}/" -H 'Content-Type: application/json' -d 'not json' \
+  | grep -q '\-32700' || fail "parse error code"
+curl -s -X POST "${BASE}/" -H 'Content-Type: application/json' \
+  -d '{"jsonrpc":"2.0","method":"tools/call","id":3,"params":{"name":"no_such_tool","arguments":{}}}' \
+  | grep -q '\-32601' || fail "unknown tool code"
+CODE=$(curl -s -o /dev/null -w '%{http_code}' -X POST "${BASE}/" \
+  -H 'Content-Type: text/plain' -d '{}')
+[ "$CODE" = "415" ] || fail "content-type not enforced (got $CODE)"
+
+echo "== session continuity"
+SID=$(curl -s -D- -o /dev/null "${BASE}/" | tr -d '\r' \
+  | awk -F': ' 'tolower($1)=="mcp-session-id"{print $2}')
+[ -n "$SID" ] || fail "no session id issued"
+ECHOED=$(curl -s -D- -o /dev/null -H "Mcp-Session-Id: ${SID}" "${BASE}/" \
+  | tr -d '\r' | awk -F': ' 'tolower($1)=="mcp-session-id"{print $2}')
+[ "$ECHOED" = "$SID" ] || fail "session id not echoed ($SID vs $ECHOED)"
+
+echo "== /metrics"
+curl -sf "${BASE}/metrics" | grep -q 'gateway_tool_calls_total' || fail "prometheus metrics missing"
+
+echo "ALL E2E SMOKE CHECKS PASSED"
