@@ -91,20 +91,72 @@ class ContinuousBatcher:
 
         # jitted: one decode tick for the whole slot pool
         self._tick = jax.jit(self._tick_impl, donate_argnums=(1,))
-        # jitted: scatter one prefilled sequence into the shared cache
-        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
-        # jitted single-sequence prefill (family-dispatched)
-        self._prefill = jax.jit(self._prefill_impl)
+        # jitted admission — fused prefill + first-token sample + cache
+        # merge, ONE device call per admission round. Exactly two row
+        # shapes compile per sequence bucket (predictable cold-start):
+        # a single-row program for steady-state trickle admissions and
+        # a full-pool program for concurrent bursts.
+        self._admit_single = jax.jit(
+            self._admit_single_impl, donate_argnums=(3,)
+        )
+        self._admit_full = jax.jit(self._admit_full_impl, donate_argnums=(3,))
 
     # -- jitted bodies ------------------------------------------------------
 
-    def _prefill_impl(self, params, tokens, cache, true_len):
+    def _prefill_sample(self, params, tokens, true_len, seeds, temps, ks, ps):
+        """Shared admission core: prefill the right-padded prompts
+        [R, S] against a fresh mini cache, sample each row's first
+        token. Returns (first [R], mini cache)."""
+        r, s = tokens.shape
+        mini = llama_mod.KVCache.create(self.engine.cfg, r, s)
         if self._is_moe:
-            valid = jnp.arange(tokens.shape[1])[None, :] < true_len
-            return self.fam.forward(
-                params, self.engine.cfg, tokens, cache, valid=valid
+            valid = jnp.arange(s)[None, :] < true_len[:, None]
+            logits, mini = self.fam.forward(
+                params, self.engine.cfg, tokens, mini, valid=valid
             )
-        return self.fam.forward(params, self.engine.cfg, tokens, cache)
+        else:
+            logits, mini = self.fam.forward(params, self.engine.cfg, tokens, mini)
+        idx = jnp.maximum(true_len - 1, 0)
+        last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
+        first = sample_dynamic(last, seeds, jnp.int32(0), temps, ks, ps)
+        return first, mini
+
+    def _admit_single_impl(
+        self, params, tokens, true_len, cache, slot, seeds, temps, ks, ps
+    ):
+        """Admit ONE request (row shapes [1, S]) into slot `slot`."""
+        first, mini = self._prefill_sample(
+            params, tokens, true_len, seeds, temps, ks, ps
+        )
+        k = jax.lax.dynamic_update_slice(
+            cache.k, mini.k.astype(cache.k.dtype), (0, slot, 0, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            cache.v, mini.v.astype(cache.v.dtype), (0, slot, 0, 0, 0)
+        )
+        lengths = cache.length.at[slot].set(true_len[0])
+        return first, llama_mod.KVCache(k=k, v=v, length=lengths)
+
+    def _admit_full_impl(
+        self, params, tokens, true_len, cache, valid, seeds, temps, ks, ps
+    ):
+        """Admit a burst in one call: `tokens` is a full [B, S] batch
+        with admitted prompts placed at their slots' rows and
+        `valid[B]` marking them; other rows keep their cache state (a
+        row-select, not a scatter, so no duplicate-index hazards)."""
+        s = tokens.shape[1]
+        first, mini = self._prefill_sample(
+            params, tokens, true_len, seeds, temps, ks, ps
+        )
+        sel = valid[None, :, None, None, None]
+        k = cache.k.at[:, :, :s].set(
+            jnp.where(sel, mini.k.astype(cache.k.dtype), cache.k[:, :, :s])
+        )
+        v = cache.v.at[:, :, :s].set(
+            jnp.where(sel, mini.v.astype(cache.v.dtype), cache.v[:, :, :s])
+        )
+        lengths = jnp.where(valid, true_len, cache.length)
+        return first, llama_mod.KVCache(k=k, v=v, length=lengths)
 
     def _tick_impl(self, tokens, cache, seeds, step, temps, ks, ps, active):
         """One device call = `decode_steps_per_tick` fused decode steps
@@ -132,19 +184,49 @@ class ContinuousBatcher:
         )
         return toks.T, cache  # [B, steps_per_tick]
 
-    def _insert_impl(self, cache, rows_k, rows_v, slot, length):
-        """Scatter [L,1,S,KVH,Dh] prefill rows into the shared cache at
-        `slot`, set that row's length."""
-        k = jax.lax.dynamic_update_slice(
-            cache.k, rows_k.astype(cache.k.dtype), (0, slot, 0, 0, 0)
-        )
-        v = jax.lax.dynamic_update_slice(
-            cache.v, rows_v.astype(cache.v.dtype), (0, slot, 0, 0, 0)
-        )
-        lengths = cache.length.at[slot].set(length)
-        return llama_mod.KVCache(k=k, v=v, length=lengths)
-
     # -- public API ---------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Compile the decode tick and both admission programs (for the
+        smallest prompt bucket) with inert inputs BEFORE serving —
+        otherwise the cold compiles land inside the first requests'
+        latency (minutes over a remote-compile TPU link).
+
+        PRE-SERVING ONLY: the _admit_single call overwrites slot 0's
+        cache rows (no valid mask on that path) and the tick advances
+        every row's length counter — harmless while no slot is active,
+        corrupting if ever run under load. Each call donates and
+        returns the cache, so reassign it."""
+        s = bucket_len(1, maximum=self.max_seq)
+        b = len(self.slots)
+        zeros1 = np.zeros((1, s), np.int32)
+        zlen1 = np.zeros((1,), np.int32)
+        zseed1 = np.zeros((1,), np.uint32)
+        zf1 = np.zeros((1,), np.float32)
+        zi1 = np.zeros((1,), np.int32)
+        of1 = np.ones((1,), np.float32)
+        _, self.cache = self._admit_single(
+            self.engine.params, jnp.asarray(zeros1), jnp.asarray(zlen1),
+            self.cache, jnp.int32(0), jnp.asarray(zseed1),
+            jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
+        )
+        _, self.cache = self._admit_full(
+            self.engine.params, jnp.asarray(np.zeros((b, s), np.int32)),
+            jnp.asarray(np.zeros((b,), np.int32)), self.cache,
+            jnp.asarray(np.zeros((b,), bool)),
+            jnp.asarray(np.zeros((b,), np.uint32)),
+            jnp.asarray(np.zeros((b,), np.float32)),
+            jnp.asarray(np.zeros((b,), np.int32)),
+            jnp.asarray(np.ones((b,), np.float32)),
+        )
+        _, self.cache = self._tick(
+            jnp.asarray(self.cur_tokens), self.cache,
+            jnp.asarray(self.seeds), jnp.int32(0),
+            jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+            jnp.asarray(self.top_ps),
+            jnp.asarray(np.zeros((b,), bool)),
+        )
+        jax.block_until_ready(self.cache.k)
 
     def start(self) -> None:
         if self._task is None:
@@ -229,91 +311,141 @@ class ContinuousBatcher:
                     slot.request = None
                     slot.done = False
                 # The tick donated the shared cache, so its buffers are
-                # dead after an error — rebuild, or every future admit's
-                # _insert would fail and no request could ever succeed.
+                # dead after an error — rebuild, or every future
+                # admission scatter would fail and no request could
+                # ever succeed.
                 self.cache = self.engine.make_cache(
                     len(self.slots), self.max_seq
                 )
             await asyncio.sleep(0)  # let handlers drain queues
 
     async def _admit(self) -> int:
-        """Admit pending requests into free slots, prefilling each."""
+        """Admit pending requests into free slots. Pending requests are
+        drained into one batch per round (capped at the free slots);
+        a burst costs ONE device call (fused prefill+sample+merge via
+        the full-pool program), a trickle of ≤2 uses the cheaper
+        single-row program."""
         admitted = 0
         deadline = time.monotonic() + self.cfg.max_queue_delay_ms / 1000.0
         loop = asyncio.get_running_loop()
         while self._free_slots():
-            try:
-                timeout = deadline - time.monotonic()
-                if timeout <= 0 or admitted >= len(self.slots):
+            batch: list[_Request] = []
+            budget = len(self._free_slots())
+            while len(batch) < budget:
+                try:
+                    timeout = deadline - time.monotonic()
+                    if timeout <= 0 or admitted + len(batch) >= len(self.slots):
+                        break
+                    if self._active_count() > 0 or admitted > 0 or batch:
+                        # Don't stall running decodes for stragglers.
+                        request = self.pending.get_nowait()
+                    else:
+                        request = await asyncio.wait_for(
+                            self.pending.get(), timeout=timeout
+                        )
+                except (asyncio.TimeoutError, asyncio.QueueEmpty):
                     break
-                if self._active_count() > 0 or admitted > 0:
-                    # Don't stall running decodes waiting for stragglers.
-                    request = self.pending.get_nowait()
-                else:
-                    request = await asyncio.wait_for(
-                        self.pending.get(), timeout=timeout
-                    )
-            except (asyncio.TimeoutError, asyncio.QueueEmpty):
+                if not request.cancelled:
+                    batch.append(request)
+            if not batch:
                 break
-            if request.cancelled:
-                continue
-            slot_idx = self._free_slots()[0]
+            slots_idx = self._free_slots()[: len(batch)]
             try:
                 await loop.run_in_executor(
-                    None, self._prefill_into_slot, slot_idx, request
+                    None, self._prefill_into_slots, slots_idx, batch
                 )
             except Exception:
-                # Fail THIS request; a poisoned prompt must not kill
-                # the batching loop (every later submit would hang).
-                logger.exception("prefill failed for slot %d", slot_idx)
-                slot = self.slots[slot_idx]
-                slot.active = False
-                slot.request = None
-                self._loop_ref.call_soon_threadsafe(
-                    request.out.put_nowait, ([], "error")
+                # The admit call donated the shared cache, so its
+                # buffers may be dead — rebuild it, which also wipes
+                # every ACTIVE slot's KV rows. Fail the batch AND all
+                # in-flight requests (mirrors the tick-failure path;
+                # anything less would silently stream garbage from the
+                # zeroed cache), but keep the loop alive.
+                logger.exception(
+                    "batched prefill failed for slots %s", slots_idx
+                )
+                for request in batch:
+                    self._loop_ref.call_soon_threadsafe(
+                        request.out.put_nowait, ([], "error")
+                    )
+                for slot in self.slots:
+                    if slot.active and slot.request is not None:
+                        self._loop_ref.call_soon_threadsafe(
+                            slot.request.out.put_nowait, ([], "error")
+                        )
+                    slot.active = False
+                    slot.request = None
+                    slot.done = False
+                self.cache = self.engine.make_cache(
+                    len(self.slots), self.max_seq
                 )
                 continue
-            admitted += 1
+            admitted += len(batch)
         return admitted
 
-    def _prefill_into_slot(self, slot_idx: int, request: _Request) -> None:
-        prompt = request.prompt
-        s = bucket_len(len(prompt), maximum=self.max_seq)
-        tokens = np.zeros((1, s), np.int32)
-        tokens[0, : len(prompt)] = prompt
-        # Single-sequence prefill producing this row's cache prefix.
-        mini_cache = llama_mod.KVCache.create(self.engine.cfg, 1, s)
-        logits, mini_cache = self._prefill(
-            self.engine.params, jnp.asarray(tokens), mini_cache,
-            jnp.int32(len(prompt)),
+    def _prefill_into_slots(
+        self, slots_idx: list[int], batch: list[_Request]
+    ) -> None:
+        """One fused device call admitting `batch` into `slots_idx`:
+        the single-row program for one request, the full-pool program
+        for a burst (row index == slot index)."""
+        s = bucket_len(
+            max(len(req.prompt) for req in batch), maximum=self.max_seq
         )
-        first = sample_dynamic(
-            logits[:, len(prompt) - 1],
-            jnp.asarray([request.seed], jnp.uint32),
-            jnp.int32(0),
-            jnp.asarray([request.sampling.temperature], jnp.float32),
-            jnp.asarray([request.sampling.top_k], jnp.int32),
-            jnp.asarray([request.sampling.top_p], jnp.float32),
-        )
-        first_tok = int(first[0])
-        # Pad prefill rows to the shared cache length on the host side
-        # is unnecessary: dynamic_update_slice handles smaller blocks.
-        self.cache = self._insert(
-            self.cache, mini_cache.k, mini_cache.v,
-            jnp.int32(slot_idx), jnp.int32(len(prompt)),
-        )
-        slot = self.slots[slot_idx]
-        slot.active = True
-        slot.request = request
-        slot.generated = 0
-        slot.max_new = request.max_new
-        slot.done = False
-        self.cur_tokens[slot_idx] = first_tok
-        self.temps[slot_idx] = request.sampling.temperature
-        self.top_ks[slot_idx] = request.sampling.top_k
-        self.top_ps[slot_idx] = request.sampling.top_p
-        self.seeds[slot_idx] = request.seed & 0xFFFFFFFF
-        self._emit(slot_idx, first_tok)
+        single = len(batch) == 1
+        rows = 1 if single else len(self.slots)
+        if not single and len(batch) <= 2:
+            # Tiny burst: two serial single-row calls beat one full-pool
+            # prefill (compute scales with rows; round-trips are ~equal).
+            for slot_idx, req in zip(slots_idx, batch):
+                self._prefill_into_slots([slot_idx], [req])
+            return
+        row_of = (lambda j: 0) if single else (lambda j: slots_idx[j])
+        tokens = np.zeros((rows, s), np.int32)
+        true_len = np.zeros((rows,), np.int32)
+        seeds = np.zeros((rows,), np.uint32)
+        temps = np.zeros((rows,), np.float32)
+        ks = np.zeros((rows,), np.int32)
+        ps = np.ones((rows,), np.float32)
+        valid = np.zeros((rows,), bool)
+        for j, req in enumerate(batch):
+            row = row_of(j)
+            tokens[row, : len(req.prompt)] = req.prompt
+            true_len[row] = len(req.prompt)
+            seeds[row] = req.seed & 0xFFFFFFFF
+            temps[row] = req.sampling.temperature
+            ks[row] = req.sampling.top_k
+            ps[row] = req.sampling.top_p
+            valid[row] = True
+        if single:
+            first, self.cache = self._admit_single(
+                self.engine.params, jnp.asarray(tokens),
+                jnp.asarray(true_len), self.cache,
+                jnp.int32(slots_idx[0]), jnp.asarray(seeds),
+                jnp.asarray(temps), jnp.asarray(ks), jnp.asarray(ps),
+            )
+        else:
+            first, self.cache = self._admit_full(
+                self.engine.params, jnp.asarray(tokens),
+                jnp.asarray(true_len), self.cache, jnp.asarray(valid),
+                jnp.asarray(seeds), jnp.asarray(temps), jnp.asarray(ks),
+                jnp.asarray(ps),
+            )
+        first = np.asarray(first)
+        for j, (slot_idx, req) in enumerate(zip(slots_idx, batch)):
+            row = row_of(j)
+            slot = self.slots[slot_idx]
+            slot.active = True
+            slot.request = req
+            slot.generated = 0
+            slot.max_new = req.max_new
+            slot.done = False
+            self.cur_tokens[slot_idx] = first[row]
+            self.temps[slot_idx] = temps[row]
+            self.top_ks[slot_idx] = ks[row]
+            self.top_ps[slot_idx] = ps[row]
+            self.seeds[slot_idx] = seeds[row]
+            self._emit(slot_idx, int(first[row]))
 
     def _tick_sync(self) -> None:
         step0 = self.step_counter

@@ -356,6 +356,11 @@ class Sidecar:
         bind = port if port is not None else self.serving.port
         self.port = self.server.add_insecure_port(f"0.0.0.0:{bind}")
         if self.batcher is not None:
+            # Compile decode/admission programs before accepting traffic
+            # (device-bound → executor, not the event loop).
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.batcher.warmup
+            )
             self.batcher.start()
         await self.server.start()
         logger.info(
