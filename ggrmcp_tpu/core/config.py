@@ -285,6 +285,17 @@ class ServingConfig:
     # Weight quantization for decoder serving: "" (off) or "int8"
     # (per-channel weight-only — halves HBM traffic on decode).
     quantize: str = ""
+    # Speculative decoding (greedy/lossless): registry key of a small
+    # dense draft model sharing the target's vocab ("" → off). Unary
+    # greedy Generate calls then verify `speculative_gamma` drafted
+    # tokens per target forward (ops/speculative.py). Tradeoff: these
+    # calls bypass the continuous batcher (each runs its own device
+    # program), so enable for latency-sensitive low-concurrency greedy
+    # traffic, not for saturation workloads.
+    speculative_draft: str = ""
+    speculative_gamma: int = 4
+    # Orbax checkpoint for the draft's params (empty → random init).
+    speculative_draft_checkpoint: str = ""
 
 
 # ---------------------------------------------------------------------------
@@ -343,6 +354,8 @@ class Config:
             raise ValueError("descriptor set enabled but no path given")
         if self.serving.batching.decode_steps_per_tick < 1:
             raise ValueError("decode_steps_per_tick must be >= 1")
+        if self.serving.speculative_gamma < 1:
+            raise ValueError("speculative_gamma must be >= 1")
         if self.serving.quantize not in ("", "int8"):
             # Catch typos at parse time, before minutes of checkpoint
             # loading (the engine re-checks at apply time).

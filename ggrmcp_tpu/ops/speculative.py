@@ -1,0 +1,215 @@
+"""Greedy speculative decoding: a small draft model proposes gamma
+tokens per round; the target model verifies ALL of them in ONE parallel
+forward — the TPU-shaped trade: gamma sequential target decode steps
+(small, latency-bound matmuls) become one (gamma+1)-token forward that
+keeps the MXU busy, plus a cheap draft loop.
+
+Acceptance is exact-match (greedy): a proposed token is accepted iff
+the target's argmax at that position equals it, so the emitted sequence
+is IDENTICAL to target-only greedy decoding regardless of draft quality
+— a correctness invariant the tests pin down. The whole generation is
+one jitted program: an outer `lax.while_loop` over verify rounds, the
+draft's proposal loop as an inner `lax.scan`, KV caches as fixed-size
+carries with explicit per-row length accounting (rollback on rejection
+= set the length counter; stale KV beyond it is masked by the causal
+attention window).
+
+No reference analogue (the Go gateway executes no models); this is a
+serving-plane throughput component like ops/quant.py.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SpecResult(NamedTuple):
+    tokens: jnp.ndarray  # [B, max_new] — includes the eos when stopped
+    out_len: jnp.ndarray  # [B] — tokens up to and including first eos
+    rounds: jnp.ndarray  # scalar — verify rounds executed
+    drafted: jnp.ndarray  # scalar — draft tokens proposed
+    accepted: jnp.ndarray  # scalar — draft tokens accepted
+
+
+def speculative_generate(
+    target_fam,
+    target_params,
+    target_cfg,
+    draft_fam,
+    draft_params,
+    draft_cfg,
+    tokens: jnp.ndarray,  # [B, S] right-padded prompts
+    true_len: jnp.ndarray,  # [B]
+    max_new_budget: int,
+    gamma: int,
+    eos_id,
+    max_new=None,  # traced per-call cap ≤ max_new_budget (None → budget)
+) -> SpecResult:
+    """Generate up to `max_new` tokens per row, greedy, speculative.
+
+    `max_new_budget` is static (sizes the output buffer — bucket it to
+    bound compilations); `max_new` is traced, so different request caps
+    reuse the same compiled program and decoding stops at the cap.
+
+    The family modules supply the serving `forward(params, cfg, tokens,
+    cache) -> (logits, cache)` contract (models/llama.py). Dense
+    decoders only: MoE routing is batch-global, so per-round token
+    counts would change expert assignment and break the lossless
+    guarantee (the engine rejects MoE targets/drafts up front). The two
+    models must share a tokenizer/vocab.
+    """
+    b, s = tokens.shape
+    if max_new is None:
+        max_new = max_new_budget
+    max_new = jnp.minimum(jnp.int32(max_new), max_new_budget)
+    budget = s + max_new_budget + gamma + 2  # verify may overshoot
+    tcache = _kv_class(target_fam).create(target_cfg, b, budget)
+    dcache = _kv_class(draft_fam).create(draft_cfg, b, budget)
+
+    # Prefill both models on the prompt.
+    tlogits, tcache = target_fam.forward(target_params, target_cfg, tokens, tcache)
+    _, dcache = draft_fam.forward(draft_params, draft_cfg, tokens, dcache)
+    last_idx = jnp.maximum(true_len - 1, 0)
+    first = jnp.argmax(
+        jnp.take_along_axis(tlogits, last_idx[:, None, None], axis=1)[:, 0],
+        axis=-1,
+    ).astype(jnp.int32)  # [B] — first generated token t0
+
+    # Roll both caches back to the true prompt length (prefill advanced
+    # them by the padded S). The draft additionally steps back one more:
+    # each round re-feeds [prev, cur] so `prev` rewrites its own slot.
+    tcache = tcache._replace(length=true_len)
+    dcache = dcache._replace(length=jnp.maximum(true_len - 1, 0))
+    prev = jnp.take_along_axis(tokens, last_idx[:, None], axis=1)[:, 0]
+
+    # Column max_new_budget is scratch: masked/overflow writes are
+    # routed there so in-range positions never see duplicate-index
+    # scatter collisions (with .set, duplicates pick an arbitrary
+    # winner).
+    out = jnp.zeros((b, max_new_budget + 1), jnp.int32)
+    out = out.at[:, 0].set(first)
+    out_len = jnp.ones((b,), jnp.int32)
+    has_eos = first == eos_id
+
+    def cond(carry):
+        (_, _, _, _, _, out_len, has_eos, _stats) = carry
+        return jnp.any(~has_eos & (out_len < max_new))
+
+    def round_body(carry):
+        tcache, dcache, prev, cur, out, out_len, has_eos, stats = carry
+        rounds, drafted, accepted = stats
+
+        # --- draft proposes gamma tokens -----------------------------
+        # First step feeds [prev, cur] (prev rewrites its own KV slot,
+        # cur extends), then gamma-1 single-token steps.
+        two = jnp.stack([prev, cur], axis=1)  # [B, 2]
+        dlogits, dcache2 = draft_fam.forward(
+            draft_params, draft_cfg, two, dcache
+        )
+        d1 = jnp.argmax(dlogits[:, -1], axis=-1).astype(jnp.int32)
+
+        def draft_step(c, _):
+            tok, dc = c
+            lg, dc = draft_fam.forward(
+                draft_params, draft_cfg, tok[:, None], dc
+            )
+            nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+            return (nxt, dc), nxt
+
+        if gamma > 1:
+            (_, dcache2), rest = jax.lax.scan(
+                draft_step, (d1, dcache2), None, length=gamma - 1
+            )
+            proposals = jnp.concatenate([d1[:, None], rest.T], axis=1)
+        else:
+            proposals = d1[:, None]  # [B, gamma]
+
+        # --- target verifies in ONE forward --------------------------
+        verify_in = jnp.concatenate([cur[:, None], proposals], axis=1)
+        vlogits, tcache2 = target_fam.forward(
+            target_params, target_cfg, verify_in, tcache
+        )
+        greedy = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, gamma+1]
+        # greedy[:, i] is the target's token AFTER verify_in[:, i]:
+        # proposal i (= proposals[:, i]) is accepted iff it equals
+        # greedy[:, i] and all earlier proposals were accepted.
+        match = proposals == greedy[:, :gamma]
+        acc_mask = jnp.cumprod(match.astype(jnp.int32), axis=1)
+        a = acc_mask.sum(axis=1)  # [B] in [0, gamma]
+        correction = jnp.take_along_axis(greedy, a[:, None], axis=1)[:, 0]
+
+        # --- emit [d_1..d_a, correction] -----------------------------
+        idx = jnp.arange(gamma + 1)[None, :]
+        cand = jnp.where(
+            idx < a[:, None],
+            jnp.pad(proposals, ((0, 0), (0, 1))),
+            jnp.where(idx == a[:, None], correction[:, None], 0),
+        )  # [B, gamma+1]
+        c = a + 1
+        live = ~has_eos
+        pos = out_len[:, None] + idx  # [B, gamma+1]
+        write = live[:, None] & (idx < c[:, None]) & (pos < max_new)
+        batch_idx = jnp.arange(b)[:, None]
+        safe_pos = jnp.where(write, pos, max_new_budget)  # scratch column
+        out = out.at[batch_idx, safe_pos].set(cand)
+        emitted = jnp.where(live, jnp.minimum(c, max_new - out_len), 0)
+        out_len = out_len + emitted
+        new_eos = (jnp.where(write, cand, -1) == eos_id).any(axis=1)
+        has_eos = has_eos | new_eos
+
+        # --- cache/length accounting (rollback on rejection) ---------
+        # Target consumed [cur, d_1..d_gamma] at tlen..tlen+gamma; the
+        # valid prefix after acceptance ends at d_a → length = tlen+a+1.
+        # Draft's next [prev', cur'] = [last-accepted, correction], and
+        # prev' must rewrite its own slot → dlen' = dlen + 1 + a.
+        tlen = tcache.length
+        dlen = dcache.length
+        tcache2 = tcache2._replace(
+            length=jnp.where(live, tlen + a + 1, tlen)
+        )
+        dcache2 = dcache2._replace(
+            length=jnp.where(live, dlen + 1 + a, dlen)
+        )
+        prev2 = jnp.where(
+            a == 0, cur,
+            jnp.take_along_axis(
+                proposals, jnp.maximum(a - 1, 0)[:, None], axis=1
+            )[:, 0],
+        )
+        prev = jnp.where(live, prev2, prev)
+        cur = jnp.where(live, correction, cur)
+
+        stats = (
+            rounds + 1,
+            drafted + jnp.sum(jnp.where(live, gamma, 0)),
+            accepted + jnp.sum(jnp.where(live, a, 0)),
+        )
+        return (tcache2, dcache2, prev, cur, out, out_len, has_eos, stats)
+
+    stats0 = (jnp.int32(0), jnp.int32(0), jnp.int32(0))
+    carry = (tcache, dcache, prev, first, out, out_len, has_eos, stats0)
+    (_, _, _, _, out, out_len, _, stats) = jax.lax.while_loop(
+        cond, round_body, carry
+    )
+
+    out = out[:, :max_new_budget]  # drop the scratch column
+    # Same eos post-pass as the plain fused path (engine._generate_impl):
+    # out_len counts tokens up to and including the first eos.
+    is_eos = out == eos_id
+    any_eos = is_eos.any(axis=1)
+    first_eos = jnp.argmax(is_eos, axis=1)
+    final_len = jnp.where(
+        any_eos, jnp.minimum(first_eos + 1, out_len), out_len
+    )
+    return SpecResult(
+        tokens=out, out_len=final_len,
+        rounds=stats[0], drafted=stats[1], accepted=stats[2],
+    )
+
+
+def _kv_class(fam):
+    """The family's KV cache type (models expose it as `KVCache`)."""
+    return fam.KVCache

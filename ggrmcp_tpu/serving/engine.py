@@ -143,6 +143,78 @@ class GenerationEngine:
         self._generate_fn = jax.jit(
             self._generate_impl, static_argnums=(2, 3)
         )
+        self._init_speculative(seed)
+
+    def _init_speculative(self, seed: int) -> None:
+        """Build the draft model when speculative decoding is enabled
+        (serving.speculative_draft); greedy-only, lossless (see
+        ops/speculative.py)."""
+        self.draft_fam = None
+        if not self.serving.speculative_draft:
+            return
+        from ggrmcp_tpu import models as models_mod
+
+        if self.fam is moe_mod:
+            raise ValueError(
+                "speculative decoding supports dense decoder targets "
+                "only (MoE routing is batch-global, which breaks the "
+                "lossless verification guarantee)"
+            )
+        family, dcfg = models_mod.get_model(self.serving.speculative_draft)
+        if family != "llama":
+            raise ValueError(
+                "speculative draft must be a dense decoder model"
+            )
+        if dcfg.vocab_size != self.cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {dcfg.vocab_size} != target vocab "
+                f"{self.cfg.vocab_size}"
+            )
+        self.draft_cfg = dcfg
+        self.draft_fam = models_mod.family_module(dcfg)
+        if self.serving.speculative_draft_checkpoint:
+            from ggrmcp_tpu.serving.checkpoint import restore
+
+            like = jax.eval_shape(
+                partial(self.draft_fam.init_params, cfg=dcfg),
+                jax.random.PRNGKey(0),
+            )
+            params = restore(
+                self.serving.speculative_draft_checkpoint, like=like
+            )
+            self.draft_params = _shard_params(
+                params, self.draft_fam.param_specs(dcfg), self.mesh
+            )
+        else:
+            self.draft_params = _sharded_init(
+                partial(self.draft_fam.init_params, cfg=dcfg),
+                self.draft_fam.param_specs(dcfg), self.mesh,
+                jax.random.PRNGKey(seed + 1),
+            )
+        self._spec_fn = jax.jit(self._spec_impl, static_argnums=(2,))
+
+    def _spec_impl(self, tokens, true_len, max_new_budget: int, max_new, eos_id):
+        from ggrmcp_tpu.ops.speculative import speculative_generate
+
+        return speculative_generate(
+            self.fam, self.params, self.cfg,
+            self.draft_fam, self.draft_params, self.draft_cfg,
+            tokens, true_len, max_new_budget,
+            self.serving.speculative_gamma, eos_id, max_new=max_new,
+        )
+
+    def warmup_speculative(self, max_new_budget: int = 64) -> None:
+        """Compile the speculative program for the smallest prompt
+        bucket and the given decode budget before serving traffic."""
+        if self.draft_fam is None:
+            return
+        s = bucket_len(1, maximum=self.cfg.max_seq_len)
+        with self.mesh:
+            res = self._spec_fn(
+                jnp.zeros((1, s), jnp.int32), jnp.ones((1,), jnp.int32),
+                max_new_budget, jnp.int32(1), jnp.int32(2),
+            )
+        jax.block_until_ready(res.tokens)
 
     def _quantize_params(self, params):
         """Int8 weight-only quantization, applied on-mesh (the transform
@@ -262,6 +334,40 @@ class GenerationEngine:
                 ),
             )()
 
+    def _pack_prompts(
+        self, prompts: list[list[int]], max_new: int, limit: int
+    ) -> tuple[np.ndarray, np.ndarray, int]:
+        """Fit and right-pad prompts to a shape bucket. Returns
+        (tokens [B, S], true_len [B], fitted max_new)."""
+        fitted = [fit_request(p, max_new, limit) for p in prompts]
+        prompts = [p for p, _ in fitted]
+        max_new = min(m for _, m in fitted)
+        b = len(prompts)
+        s = bucket_len(max(len(p) for p in prompts), maximum=limit)
+        tokens = np.zeros((b, s), dtype=np.int32)
+        true_len = np.zeros((b,), dtype=np.int32)
+        for i, p in enumerate(prompts):
+            tokens[i, : len(p)] = p
+            true_len[i] = len(p)
+        return tokens, true_len, max_new
+
+    @staticmethod
+    def _decode_outputs(
+        out: np.ndarray, out_len: np.ndarray, eos_id: int
+    ) -> tuple[list[list[int]], list[str]]:
+        """[B, N] buffer + per-row lengths → (token lists with trailing
+        eos stripped, finish reasons)."""
+        results, reasons = [], []
+        for i in range(out.shape[0]):
+            ids = out[i, : out_len[i]].tolist()
+            if ids and ids[-1] == eos_id:
+                ids = ids[:-1]
+                reasons.append("stop")
+            else:
+                reasons.append("length")
+            results.append(ids)
+        return results, reasons
+
     def generate(
         self,
         prompts: list[list[int]],
@@ -272,37 +378,56 @@ class GenerationEngine:
     ) -> tuple[list[list[int]], list[str]]:
         """Batch generation via the fused path. Returns (token lists,
         finish reasons)."""
-        fitted = [
-            fit_request(p, max_new_tokens, self.cfg.max_seq_len) for p in prompts
-        ]
-        prompts = [p for p, _ in fitted]
-        max_new_tokens = min(m for _, m in fitted)
-        b = len(prompts)
-        max_prompt = max(len(p) for p in prompts)
-        s = bucket_len(max_prompt, maximum=self.cfg.max_seq_len)
-        tokens = np.zeros((b, s), dtype=np.int32)
-        true_len = np.zeros((b,), dtype=np.int32)
-        for i, p in enumerate(prompts):
-            tokens[i, : len(p)] = p
-            true_len[i] = len(p)
+        tokens, true_len, max_new_tokens = self._pack_prompts(
+            prompts, max_new_tokens, self.cfg.max_seq_len
+        )
         with self.mesh:
             out, out_len = self._generate_fn(
                 jnp.asarray(tokens), jnp.asarray(true_len),
                 max_new_tokens, sampling,
                 jax.random.PRNGKey(seed), jnp.int32(eos_id),
             )
-        out = np.asarray(out)
-        out_len = np.asarray(out_len)
-        results, reasons = [], []
-        for i in range(b):
-            ids = out[i, : out_len[i]].tolist()
-            if ids and ids[-1] == eos_id:
-                ids = ids[:-1]
-                reasons.append("stop")
-            else:
-                reasons.append("length")
-            results.append(ids)
-        return results, reasons
+        return self._decode_outputs(
+            np.asarray(out), np.asarray(out_len), eos_id
+        )
+
+    def generate_speculative(
+        self,
+        prompts: list[list[int]],
+        max_new_tokens: int = 128,
+        eos_id: int = 2,
+    ) -> tuple[list[list[int]], list[str], dict]:
+        """Greedy speculative batch generation (requires a configured
+        draft model). Output is identical to greedy `generate`; returns
+        (token lists, finish reasons, stats with acceptance rate). The
+        decode budget is bucketed (static buffer) while the requested
+        cap rides as a traced arg, so request-to-request max_new
+        changes reuse the compiled program."""
+        if self.draft_fam is None:
+            raise RuntimeError("speculative decoding not configured")
+        limit = min(self.cfg.max_seq_len, self.draft_cfg.max_seq_len)
+        tokens, true_len, max_new_tokens = self._pack_prompts(
+            prompts, max_new_tokens, limit
+        )
+        budget = bucket_len(max_new_tokens, minimum=8, maximum=limit)
+        with self.mesh:
+            res = self._spec_fn(
+                jnp.asarray(tokens), jnp.asarray(true_len),
+                budget, jnp.int32(max_new_tokens), jnp.int32(eos_id),
+            )
+        results, reasons = self._decode_outputs(
+            np.asarray(res.tokens), np.asarray(res.out_len), eos_id
+        )
+        drafted = int(res.drafted)
+        stats = {
+            "rounds": int(res.rounds),
+            "drafted": drafted,
+            "accepted": int(res.accepted),
+            "acceptance_rate": (
+                round(int(res.accepted) / drafted, 4) if drafted else 0.0
+            ),
+        }
+        return results, reasons, stats
 
     def generate_stream(
         self,

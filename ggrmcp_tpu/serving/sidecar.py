@@ -158,6 +158,11 @@ class Sidecar:
         seed = request.sampling.seed or 0
         token_ids: list[int] = []
         finish = "length"
+        sampling = self._sampling(request)
+        speculative = (
+            self.generation.draft_fam is not None
+            and sampling.temperature <= 0.0
+        )
         with tracing.tracer.span(
             "sidecar.generate",
             trace_id=tracing.trace_id_from_metadata(
@@ -165,12 +170,30 @@ class Sidecar:
             ) or None,
             model=self.generation.cfg.name, prompt_tokens=len(prompt),
         ) as span:
-            async for chunk_ids, reason in self.batcher.submit(
-                prompt, max_new, self._sampling(request), seed
-            ):
-                token_ids.extend(chunk_ids)
-                if reason:
-                    finish = reason
+            if speculative:
+                # Greedy + draft configured → lossless speculative path
+                # (one fused device program; see ops/speculative.py).
+                loop = asyncio.get_running_loop()
+                try:
+                    outs, reasons, stats = await loop.run_in_executor(
+                        None,
+                        lambda: self.generation.generate_speculative(
+                            [prompt], max_new,
+                            eos_id=self.tokenizer.eos_id,
+                        ),
+                    )
+                    token_ids, finish = outs[0], reasons[0]
+                    span.set(**stats)
+                except Exception:
+                    logger.exception("speculative generation failed")
+                    finish = "error"
+            else:
+                async for chunk_ids, reason in self.batcher.submit(
+                    prompt, max_new, sampling, seed
+                ):
+                    token_ids.extend(chunk_ids)
+                    if reason:
+                        finish = reason
             span.set(completion_tokens=len(token_ids), finish=finish)
         if finish == "error":
             await context.abort(
@@ -361,6 +384,10 @@ class Sidecar:
             await asyncio.get_running_loop().run_in_executor(
                 None, self.batcher.warmup
             )
+            if self.generation is not None:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.generation.warmup_speculative
+                )
             self.batcher.start()
         await self.server.start()
         logger.info(

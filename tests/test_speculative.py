@@ -1,0 +1,139 @@
+"""Speculative decoding: losslessness (output identical to target-only
+greedy regardless of draft quality), acceptance accounting, EOS and
+length semantics — on the virtual CPU mesh."""
+
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.core.config import MeshConfig, ServingConfig
+from ggrmcp_tpu.models import llama
+from ggrmcp_tpu.serving.engine import GenerationEngine
+
+
+def spec_cfg(**kw) -> ServingConfig:
+    kw.setdefault("model", "tiny-llama")
+    kw.setdefault("mesh", MeshConfig(tensor=2, data=0))
+    kw.setdefault("speculative_draft", "tiny-llama")
+    return ServingConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    # Draft = same architecture, DIFFERENT random params (seed offset in
+    # _init_speculative): realistic imperfect-draft acceptance.
+    return GenerationEngine(llama.CONFIGS["tiny-llama"], spec_cfg())
+
+
+PROMPTS = [[3, 1, 4, 1, 5], [9, 2, 6], [7, 7, 7, 7, 7, 7, 7, 1]]
+
+
+class TestLossless:
+    def test_matches_plain_greedy(self, engine):
+        """The speculative invariant: emitted tokens equal target-only
+        greedy decoding even though the draft is a different model."""
+        plain, plain_reasons = engine.generate(
+            PROMPTS, max_new_tokens=12, seed=0
+        )  # SamplingConfig() default = greedy
+        spec, spec_reasons, stats = engine.generate_speculative(
+            PROMPTS, max_new_tokens=12
+        )
+        assert spec == plain
+        assert spec_reasons == plain_reasons
+        assert stats["rounds"] >= 1
+
+    def test_gamma_variants_agree(self):
+        outs = {}
+        for gamma in (1, 3):
+            eng = GenerationEngine(
+                llama.CONFIGS["tiny-llama"],
+                spec_cfg(speculative_gamma=gamma),
+            )
+            outs[gamma], _, _ = eng.generate_speculative(
+                PROMPTS[:2], max_new_tokens=10
+            )
+        assert outs[1] == outs[3]
+
+
+class TestAccounting:
+    def test_perfect_draft_accepts_everything(self):
+        """Draft sharing the target's params (self-speculation) must be
+        accepted at 100%: every round emits gamma+1 tokens."""
+        eng = GenerationEngine(llama.CONFIGS["tiny-llama"], spec_cfg())
+        eng.draft_params = eng.params  # identical draft
+        eng.draft_cfg = eng.cfg
+        eng.draft_fam = eng.fam
+        out, _, stats = eng.generate_speculative([[5, 3, 8]], max_new_tokens=12)
+        assert stats["acceptance_rate"] == 1.0
+        # 12 tokens at gamma+1=5/round (first token from prefill) → 3 rounds
+        assert stats["rounds"] <= 3
+        assert len(out[0]) <= 12
+
+    def test_length_cap_respected(self, engine):
+        out, reasons, _ = engine.generate_speculative(
+            [[2 + i] for i in range(3)], max_new_tokens=5
+        )
+        for ids, reason in zip(out, reasons):
+            assert len(ids) <= 5
+            assert reason in ("stop", "length")
+
+    def test_unconfigured_engine_raises(self):
+        eng = GenerationEngine(
+            llama.CONFIGS["tiny-llama"],
+            ServingConfig(model="tiny-llama", mesh=MeshConfig(tensor=2, data=0)),
+        )
+        with pytest.raises(RuntimeError, match="not configured"):
+            eng.generate_speculative([[1, 2, 3]])
+
+
+class TestSidecarIntegration:
+    async def test_unary_greedy_uses_speculative(self):
+        import grpc
+        import grpc.aio
+
+        from ggrmcp_tpu.rpc.pb import serving_pb2
+        from ggrmcp_tpu.serving.sidecar import Sidecar
+
+        side = Sidecar(spec_cfg(model="tiny-llama"))
+        port = await side.start(0)
+        channel = grpc.aio.insecure_channel(f"localhost:{port}")
+        try:
+            gen = channel.unary_unary(
+                "/ggrmcp.tpu.GenerateService/Generate",
+                request_serializer=serving_pb2.GenerateRequest.SerializeToString,
+                response_deserializer=serving_pb2.GenerateResponse.FromString,
+            )
+            resp = await gen(
+                serving_pb2.GenerateRequest(
+                    prompt="spec", max_new_tokens=6, return_tokens=True
+                )  # no sampling → temperature 0 → speculative path
+            )
+            assert resp.completion_tokens == len(resp.token_ids) <= 6
+            assert resp.finish_reason in ("length", "stop")
+        finally:
+            await channel.close()
+            await side.stop()
+
+
+class TestValidation:
+    def test_embedding_draft_rejected(self):
+        with pytest.raises(ValueError, match="decoder"):
+            GenerationEngine(
+                llama.CONFIGS["tiny-llama"],
+                spec_cfg(speculative_draft="bert-tiny"),
+            )
+
+    def test_vocab_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="vocab"):
+            GenerationEngine(
+                llama.CONFIGS["tiny-llama"],
+                spec_cfg(speculative_draft="llama-1b"),
+            )
+
+    def test_moe_target_rejected(self):
+        from ggrmcp_tpu.models import moe
+
+        with pytest.raises(ValueError, match="dense"):
+            GenerationEngine(
+                moe.CONFIGS["tiny-moe"],
+                spec_cfg(model="tiny-moe"),
+            )
