@@ -149,7 +149,9 @@ def speculative_generate(
             jnp.where(idx == a[:, None], correction[:, None], 0),
         )  # [B, gamma+1]
         c = a + 1
-        live = ~has_eos
+        # A row is live until EOS or its length cap — capped rows must
+        # stop advancing cache lengths and inflating draft statistics.
+        live = ~has_eos & (out_len < max_new)
         pos = out_len[:, None] + idx  # [B, gamma+1]
         write = live[:, None] & (idx < c[:, None]) & (pos < max_new)
         batch_idx = jnp.arange(b)[:, None]

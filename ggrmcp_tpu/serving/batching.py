@@ -100,6 +100,13 @@ class ContinuousBatcher:
             self._admit_single_impl, donate_argnums=(3,)
         )
         self._admit_full = jax.jit(self._admit_full_impl, donate_argnums=(3,))
+        # Chunked prefill for prompts longer than cfg.prefill_chunk:
+        # fixed [1, C] steps into a full-length mini cache — ONE
+        # compiled shape for any prompt length, and activations stay
+        # [1, C, ·] instead of [1, S, ·] (bounded memory at long S).
+        self._chunk_step = jax.jit(self._chunk_step_impl, donate_argnums=(2,))
+        self._insert_row = jax.jit(self._insert_row_impl, donate_argnums=(0,))
+        self._first_token = jax.jit(self._first_token_impl)
 
     # -- jitted bodies ------------------------------------------------------
 
@@ -184,6 +191,81 @@ class ContinuousBatcher:
         )
         return toks.T, cache  # [B, steps_per_tick]
 
+    def _chunk_step_impl(self, params, tokens, mini, true_len):
+        """One [1, C] prefill chunk appended to the row's mini cache at
+        its current length. Returns (last-position logits [1, V], mini)."""
+        if self._is_moe:
+            offset = mini.length[:, None]
+            valid = (offset + jnp.arange(tokens.shape[1])[None, :]) < true_len
+            logits, mini = self.fam.forward(
+                params, self.engine.cfg, tokens, mini, valid=valid
+            )
+        else:
+            logits, mini = self.fam.forward(params, self.engine.cfg, tokens, mini)
+        return logits, mini
+
+    def _insert_row_impl(self, cache, mini, slot, length):
+        """Copy a full-length [1, S_max] mini cache row into the shared
+        cache at `slot` with the row's true length."""
+        k = jax.lax.dynamic_update_slice(
+            cache.k, mini.k.astype(cache.k.dtype), (0, slot, 0, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            cache.v, mini.v.astype(cache.v.dtype), (0, slot, 0, 0, 0)
+        )
+        lengths = cache.length.at[slot].set(length)
+        return llama_mod.KVCache(k=k, v=v, length=lengths)
+
+    def _first_token_impl(self, logits, idx, seeds, temps, ks, ps):
+        last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
+        return sample_dynamic(last, seeds, jnp.int32(0), temps, ks, ps)
+
+    def _prefill_chunked(self, slot_idx: int, request: _Request) -> None:
+        """Admission for a long prompt: fixed-size chunks into a
+        full-length mini cache, then one insert + one sample."""
+        prompt = request.prompt
+        n = len(prompt)
+        c = min(self.cfg.prefill_chunk, self.max_seq)
+        mini = llama_mod.KVCache.create(self.engine.cfg, 1, self.max_seq)
+        logits = None
+        true_len = jnp.asarray([n], jnp.int32)
+        for off in range(0, n, c):
+            chunk = np.zeros((1, c), np.int32)
+            piece = prompt[off : off + c]
+            chunk[0, : len(piece)] = piece
+            logits, mini = self._chunk_step(
+                self.engine.params, jnp.asarray(chunk), mini, true_len
+            )
+        mini = mini._replace(length=jnp.asarray([n], jnp.int32))
+        self.cache = self._insert_row(
+            self.cache, mini, jnp.int32(slot_idx), jnp.int32(n)
+        )
+        # Last real token sits at (n-1) % c of the final chunk.
+        first = self._first_token(
+            logits, jnp.asarray([(n - 1) % c], jnp.int32),
+            jnp.asarray([request.seed & 0xFFFFFFFF], jnp.uint32),
+            jnp.asarray([request.sampling.temperature], jnp.float32),
+            jnp.asarray([request.sampling.top_k], jnp.int32),
+            jnp.asarray([request.sampling.top_p], jnp.float32),
+        )
+        self._activate_slot(slot_idx, request, int(np.asarray(first)[0]))
+
+    def _activate_slot(
+        self, slot_idx: int, request: _Request, first_tok: int
+    ) -> None:
+        slot = self.slots[slot_idx]
+        slot.active = True
+        slot.request = request
+        slot.generated = 0
+        slot.max_new = request.max_new
+        slot.done = False
+        self.cur_tokens[slot_idx] = first_tok
+        self.temps[slot_idx] = request.sampling.temperature
+        self.top_ks[slot_idx] = request.sampling.top_k
+        self.top_ps[slot_idx] = request.sampling.top_p
+        self.seeds[slot_idx] = request.seed & 0xFFFFFFFF
+        self._emit(slot_idx, first_tok)
+
     # -- public API ---------------------------------------------------------
 
     def warmup(self) -> None:
@@ -225,6 +307,22 @@ class ContinuousBatcher:
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
             jnp.asarray(self.top_ps),
             jnp.asarray(np.zeros((b,), bool)),
+        )
+        # Chunked-prefill programs (statically shaped: [1, C] chunk into
+        # a [1, S_max] mini cache) — the first long-prompt request must
+        # not pay their compiles.
+        c = min(self.cfg.prefill_chunk, self.max_seq)
+        mini = llama_mod.KVCache.create(self.engine.cfg, 1, self.max_seq)
+        logits, mini = self._chunk_step(
+            self.engine.params, jnp.asarray(np.zeros((1, c), np.int32)),
+            mini, jnp.asarray(zlen1),
+        )
+        self.cache = self._insert_row(
+            self.cache, mini, jnp.int32(0), jnp.int32(0)
+        )
+        _ = self._first_token(
+            logits, jnp.asarray(zi1), jnp.asarray(zseed1),
+            jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
         )
         jax.block_until_ready(self.cache.k)
 
@@ -388,7 +486,21 @@ class ContinuousBatcher:
     ) -> None:
         """One fused device call admitting `batch` into `slots_idx`:
         the single-row program for one request, the full-pool program
-        for a burst (row index == slot index)."""
+        for a burst (row index == slot index). Prompts longer than
+        cfg.prefill_chunk go through the chunked path one by one."""
+        if any(len(req.prompt) > self.cfg.prefill_chunk for req in batch):
+            short = [
+                (sl, req) for sl, req in zip(slots_idx, batch)
+                if len(req.prompt) <= self.cfg.prefill_chunk
+            ]
+            for sl, req in zip(slots_idx, batch):
+                if len(req.prompt) > self.cfg.prefill_chunk:
+                    self._prefill_chunked(sl, req)
+            if short:
+                self._prefill_into_slots(
+                    [sl for sl, _ in short], [req for _, req in short]
+                )
+            return
         s = bucket_len(
             max(len(req.prompt) for req in batch), maximum=self.max_seq
         )
@@ -433,19 +545,7 @@ class ContinuousBatcher:
             )
         first = np.asarray(first)
         for j, (slot_idx, req) in enumerate(zip(slots_idx, batch)):
-            row = row_of(j)
-            slot = self.slots[slot_idx]
-            slot.active = True
-            slot.request = req
-            slot.generated = 0
-            slot.max_new = req.max_new
-            slot.done = False
-            self.cur_tokens[slot_idx] = first[row]
-            self.temps[slot_idx] = temps[row]
-            self.top_ks[slot_idx] = ks[row]
-            self.top_ps[slot_idx] = ps[row]
-            self.seeds[slot_idx] = seeds[row]
-            self._emit(slot_idx, int(first[row]))
+            self._activate_slot(slot_idx, req, int(first[row_of(j)]))
 
     def _tick_sync(self) -> None:
         step0 = self.step_counter

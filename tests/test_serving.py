@@ -305,6 +305,65 @@ class TestFusedDecodeTicks:
             await batcher.stop()
 
 
+class TestChunkedPrefill:
+    """Prompts longer than cfg.prefill_chunk are prefilled in fixed
+    chunks; greedy output must equal the engine's whole-prompt path."""
+
+    async def test_long_prompt_matches_fused_prefill(self, gen_engine):
+        from ggrmcp_tpu.serving.batching import ContinuousBatcher
+
+        prompt = [(i * 7 + 3) % 500 + 1 for i in range(40)]
+        expected, _ = gen_engine.generate([prompt], max_new_tokens=6, seed=0)
+
+        batcher = ContinuousBatcher(
+            gen_engine,
+            BatchingConfig(
+                max_batch_size=4, kv_cache_max_seq=256, prefill_chunk=16
+            ),
+        )
+        batcher.start()
+        try:
+            out: list[int] = []
+            async for ids, reason in batcher.submit(
+                prompt, 6, SamplingConfig(temperature=0.0)
+            ):
+                out.extend(ids)
+            assert out == expected[0]
+        finally:
+            await batcher.stop()
+
+    async def test_mixed_burst_short_and_long(self, gen_engine):
+        from ggrmcp_tpu.serving.batching import ContinuousBatcher
+
+        batcher = ContinuousBatcher(
+            gen_engine,
+            BatchingConfig(
+                max_batch_size=4, kv_cache_max_seq=256, prefill_chunk=16
+            ),
+        )
+        batcher.start()
+
+        async def one(prompt, seed):
+            out: list[int] = []
+            reason = None
+            async for ids, reason in batcher.submit(
+                prompt, 5, SamplingConfig(temperature=0.0), seed=seed
+            ):
+                out.extend(ids)
+            return out, reason
+
+        try:
+            long_p = [(i * 3 + 1) % 500 + 1 for i in range(30)]
+            outs = await asyncio.gather(
+                one([4, 2], 0), one(long_p, 1), one([9, 9, 9], 2)
+            )
+            for out, reason in outs:
+                assert reason in ("length", "stop")
+                assert 1 <= len(out) <= 5
+        finally:
+            await batcher.stop()
+
+
 class TestBatcherRecovery:
     async def test_tick_failure_fails_request_then_recovers(self, gen_engine):
         """A decode-tick crash fails in-flight requests with 'error' but
