@@ -48,6 +48,25 @@ def build_parser() -> argparse.ArgumentParser:
         "--quantize", default=None, help="sidecar weight quantization (int8)"
     )
 
+    tr = sub.add_parser("train", help="fine-tune a model (checkpoint/resume)")
+    tr.add_argument("--model", default=None, help="model registry key")
+    tr.add_argument("--steps", type=int, default=None)
+    tr.add_argument("--batch-size", type=int, default=None)
+    tr.add_argument("--seq-len", type=int, default=None)
+    tr.add_argument("--learning-rate", type=float, default=None)
+    tr.add_argument(
+        "--checkpoint-dir", default=None,
+        help="root for step_N/{state,params} checkpoints",
+    )
+    tr.add_argument("--save-every", type=int, default=None)
+    tr.add_argument(
+        "--no-resume", action="store_true",
+        help="start fresh even if checkpoints exist",
+    )
+    tr.add_argument("--data", default=None, help="raw text file to train on")
+    tr.add_argument("--config", default=None, help="YAML/JSON config file")
+    tr.add_argument("--log-level", default=None)
+
     sc = sub.add_parser("sidecar", help="run the TPU serving sidecar only")
     sc.add_argument("--port", type=int, default=None, help="gRPC listen port")
     sc.add_argument("--model", default=None, help="model registry key")
@@ -89,6 +108,29 @@ def load_config(args: argparse.Namespace) -> cfgmod.Config:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.command == "train":
+        cfg = load_config(args)
+        tc = cfg.training
+        if args.model:
+            tc.model = args.model
+        for flag, attr in (
+            ("steps", "steps"), ("batch_size", "batch_size"),
+            ("seq_len", "seq_len"), ("learning_rate", "learning_rate"),
+            ("checkpoint_dir", "checkpoint_dir"),
+            ("save_every", "save_every_steps"), ("data", "data_path"),
+        ):
+            value = getattr(args, flag, None)
+            if value is not None:
+                setattr(tc, attr, value)
+        if args.no_resume:
+            tc.resume = False
+        cfg.validate()  # re-check: train flags were applied after load
+        from ggrmcp_tpu.gateway.app import setup_logging
+        from ggrmcp_tpu.models.trainer import train
+
+        setup_logging(cfg)
+        train(tc)
+        return 0
     if args.command == "sidecar":
         cfg = load_config(args)
         from ggrmcp_tpu.serving.sidecar import run as run_sidecar

@@ -272,6 +272,29 @@ class BatchingConfig:
 
 
 @dataclass
+class TrainingConfig:
+    """`python -m ggrmcp_tpu train` — the fine-tuning loop with
+    checkpoint/resume (reference has no training; SURVEY.md §5.4)."""
+
+    model: str = "tiny-llama"  # registry key in ggrmcp_tpu.models
+    steps: int = 100
+    batch_size: int = 8
+    seq_len: int = 128
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.01
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    # Checkpoint root ("" → no persistence). Each save writes
+    # <dir>/step_N/state (full resume state) and <dir>/step_N/params
+    # (weights-only, loadable by serving.checkpoint_path).
+    checkpoint_dir: str = ""
+    save_every_steps: int = 100
+    resume: bool = True  # resume from the latest step_N under the dir
+    data_path: str = ""  # raw text file ("" → synthetic token stream)
+    log_every_steps: int = 10
+    seed: int = 0
+
+
+@dataclass
 class ServingConfig:
     model: str = "tiny-llama"  # registry key in ggrmcp_tpu.models
     dtype: str = "bfloat16"
@@ -329,6 +352,7 @@ class Config:
     session: SessionConfig = field(default_factory=SessionConfig)
     tools: ToolsConfig = field(default_factory=ToolsConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    training: TrainingConfig = field(default_factory=TrainingConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
 
@@ -356,6 +380,14 @@ class Config:
             raise ValueError("decode_steps_per_tick must be >= 1")
         if self.serving.speculative_gamma < 1:
             raise ValueError("speculative_gamma must be >= 1")
+        if self.training.steps < 1 or self.training.batch_size < 1:
+            raise ValueError("training steps/batch_size must be >= 1")
+        if self.training.seq_len < 2:
+            raise ValueError("training seq_len must be >= 2 (shift-by-one loss)")
+        if self.training.log_every_steps < 1 or self.training.save_every_steps < 1:
+            raise ValueError(
+                "training log_every_steps/save_every_steps must be >= 1"
+            )
         if self.serving.quantize not in ("", "int8"):
             # Catch typos at parse time, before minutes of checkpoint
             # loading (the engine re-checks at apply time).

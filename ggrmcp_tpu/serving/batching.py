@@ -33,6 +33,21 @@ from ggrmcp_tpu.serving.engine import bucket_len, fit_request
 logger = logging.getLogger("ggrmcp.serving.batching")
 
 
+def _merge_row(cache, mini, slot, length):
+    """Merge a single prefilled row's [1, S] K/V block into the shared
+    [B, S_max] cache at `slot` and set that row's length. The one
+    cache-merge definition shared by fused and chunked admission."""
+    k = jax.lax.dynamic_update_slice(
+        cache.k, mini.k.astype(cache.k.dtype), (0, slot, 0, 0, 0)
+    )
+    v = jax.lax.dynamic_update_slice(
+        cache.v, mini.v.astype(cache.v.dtype), (0, slot, 0, 0, 0)
+    )
+    return llama_mod.KVCache(
+        k=k, v=v, length=cache.length.at[slot].set(length)
+    )
+
+
 @dataclasses.dataclass
 class _Slot:
     active: bool = False
@@ -123,9 +138,9 @@ class ContinuousBatcher:
             )
         else:
             logits, mini = self.fam.forward(params, self.engine.cfg, tokens, mini)
-        idx = jnp.maximum(true_len - 1, 0)
-        last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
-        first = sample_dynamic(last, seeds, jnp.int32(0), temps, ks, ps)
+        first = self._first_token_impl(
+            logits, jnp.maximum(true_len - 1, 0), seeds, temps, ks, ps
+        )
         return first, mini
 
     def _admit_single_impl(
@@ -135,14 +150,7 @@ class ContinuousBatcher:
         first, mini = self._prefill_sample(
             params, tokens, true_len, seeds, temps, ks, ps
         )
-        k = jax.lax.dynamic_update_slice(
-            cache.k, mini.k.astype(cache.k.dtype), (0, slot, 0, 0, 0)
-        )
-        v = jax.lax.dynamic_update_slice(
-            cache.v, mini.v.astype(cache.v.dtype), (0, slot, 0, 0, 0)
-        )
-        lengths = cache.length.at[slot].set(true_len[0])
-        return first, llama_mod.KVCache(k=k, v=v, length=lengths)
+        return first, _merge_row(cache, mini, slot, true_len[0])
 
     def _admit_full_impl(
         self, params, tokens, true_len, cache, valid, seeds, temps, ks, ps
@@ -205,16 +213,9 @@ class ContinuousBatcher:
         return logits, mini
 
     def _insert_row_impl(self, cache, mini, slot, length):
-        """Copy a full-length [1, S_max] mini cache row into the shared
-        cache at `slot` with the row's true length."""
-        k = jax.lax.dynamic_update_slice(
-            cache.k, mini.k.astype(cache.k.dtype), (0, slot, 0, 0, 0)
-        )
-        v = jax.lax.dynamic_update_slice(
-            cache.v, mini.v.astype(cache.v.dtype), (0, slot, 0, 0, 0)
-        )
-        lengths = cache.length.at[slot].set(length)
-        return llama_mod.KVCache(k=k, v=v, length=lengths)
+        """Copy a [1, ≤S_max] mini cache row into the shared cache at
+        `slot` with the row's true length (shared with fused admission)."""
+        return _merge_row(cache, mini, slot, length)
 
     def _first_token_impl(self, logits, idx, seeds, temps, ks, ps):
         last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
@@ -310,20 +311,22 @@ class ContinuousBatcher:
         )
         # Chunked-prefill programs (statically shaped: [1, C] chunk into
         # a [1, S_max] mini cache) — the first long-prompt request must
-        # not pay their compiles.
-        c = min(self.cfg.prefill_chunk, self.max_seq)
-        mini = llama_mod.KVCache.create(self.engine.cfg, 1, self.max_seq)
-        logits, mini = self._chunk_step(
-            self.engine.params, jnp.asarray(np.zeros((1, c), np.int32)),
-            mini, jnp.asarray(zlen1),
-        )
-        self.cache = self._insert_row(
-            self.cache, mini, jnp.int32(0), jnp.int32(0)
-        )
-        _ = self._first_token(
-            logits, jnp.asarray(zi1), jnp.asarray(zseed1),
-            jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
-        )
+        # not pay their compiles. Skipped when the chunked path is
+        # unreachable (every admissible prompt fits one chunk).
+        if self.cfg.prefill_chunk < self.max_seq:
+            c = min(self.cfg.prefill_chunk, self.max_seq)
+            mini = llama_mod.KVCache.create(self.engine.cfg, 1, self.max_seq)
+            logits, mini = self._chunk_step(
+                self.engine.params, jnp.asarray(np.zeros((1, c), np.int32)),
+                mini, jnp.asarray(zlen1),
+            )
+            self.cache = self._insert_row(
+                self.cache, mini, jnp.int32(0), jnp.int32(0)
+            )
+            _ = self._first_token(
+                logits, jnp.asarray(zi1), jnp.asarray(zseed1),
+                jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
+            )
         jax.block_until_ready(self.cache.k)
 
     def start(self) -> None:

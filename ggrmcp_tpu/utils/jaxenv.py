@@ -25,4 +25,8 @@ def apply_platform_env() -> None:
     current = jax.config.jax_platforms
     if current != env:
         logger.info("re-applying JAX_PLATFORMS=%s (config had %r)", env, current)
-        jax.config.update("jax_platforms", env)
+    # Always update, even when the value already matches: plugin wrappers
+    # (axon) hook backend init and only honor an EXPLICIT config update —
+    # with just the env var they still initialize their own platform,
+    # which hangs when the TPU tunnel is down.
+    jax.config.update("jax_platforms", env)
