@@ -167,6 +167,11 @@ async def _run_bench() -> dict:
     p50 = statistics.median(latencies) * 1000
     p99 = sorted(latencies)[int(len(latencies) * 0.99) - 1] * 1000
     n_chips = len(devices) if on_tpu else 1
+    try:
+        proxy = await _proxy_bench()
+    except Exception as exc:  # secondary metric must not sink the run
+        print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
+        proxy = {}
     return {
         "metric": "mcp_generate_calls_per_sec",
         "value": round(calls_per_sec, 2),
@@ -183,6 +188,89 @@ async def _run_bench() -> dict:
         "max_new_tokens": max_new,
         "tokens_per_sec": round(calls_per_sec * max_new, 1),
         "warmup_s": round(warmup_s, 1),
+        **proxy,
+    }
+
+
+async def _proxy_bench() -> dict:
+    """Gateway-only throughput: MCP tool-calls proxied to an in-process
+    hello gRPC backend, no model — the number directly comparable to
+    the reference's Go gateway (which only ever proxied)."""
+    import aiohttp
+    import grpc.aio
+
+    from ggrmcp_tpu.core import config as cfgmod
+    from ggrmcp_tpu.gateway.app import Gateway
+    from ggrmcp_tpu.rpc.pb import hello_pb2
+    from ggrmcp_tpu.rpc.server_utils import (
+        MethodDef,
+        ReflectionService,
+        add_service,
+    )
+
+    async def say_hello(request, context):
+        return hello_pb2.HelloResponse(
+            message=f"Hello, {request.name or 'world'}!"
+        )
+
+    server = grpc.aio.server()
+    add_service(
+        server, "hello.HelloService",
+        {"SayHello": MethodDef(
+            say_hello, hello_pb2.HelloRequest, hello_pb2.HelloResponse
+        )},
+    )
+    ReflectionService(["hello.HelloService"]).attach(server)
+    port = server.add_insecure_port("127.0.0.1:0")
+    await server.start()
+
+    cfg = cfgmod.default()
+    cfg.server.host = "127.0.0.1"
+    cfg.server.port = 0
+    cfg.server.rate_limit.enabled = False
+    cfg.session.rate_limit.enabled = False
+    cfg.grpc.reconnect.enabled = False
+    gateway = Gateway(cfg, targets=[f"localhost:{port}"])
+    await gateway.start()
+
+    sessions = int(os.environ.get("GGRMCP_BENCH_PROXY_SESSIONS", "16"))
+    total = int(os.environ.get("GGRMCP_BENCH_PROXY_CALLS", "480"))
+    per_session = max(1, total // sessions)
+    latencies: list[float] = []
+
+    try:
+        async with aiohttp.ClientSession(
+            base_url=f"http://127.0.0.1:{gateway.port}"
+        ) as client:
+            async def worker(sid: int):
+                for i in range(per_session):
+                    body = {
+                        "jsonrpc": "2.0", "method": "tools/call",
+                        "id": sid * 10000 + i,
+                        "params": {
+                            "name": "hello_helloservice_sayhello",
+                            "arguments": {"name": f"s{sid}-{i}"},
+                        },
+                    }
+                    t = time.perf_counter()
+                    resp = await client.post("/", json=body)
+                    data = await resp.json()
+                    latencies.append(time.perf_counter() - t)
+                    if "error" in data:
+                        raise RuntimeError(f"proxy call failed: {data['error']}")
+
+            await worker(0)  # warm discovery/schema caches
+            latencies.clear()
+            start = time.perf_counter()
+            await asyncio.gather(*(worker(s) for s in range(sessions)))
+            elapsed = time.perf_counter() - start
+    finally:
+        await gateway.stop()
+        await server.stop(grace=0.5)
+
+    return {
+        "proxy_calls_per_sec": round(per_session * sessions / elapsed, 1),
+        "proxy_p50_ms": round(statistics.median(latencies) * 1000, 2),
     }
 
 
