@@ -43,6 +43,8 @@ async def serve(port: int) -> None:
     HealthService().attach(server)
     bound = server.add_insecure_port(f"0.0.0.0:{port}")
     await server.start()
+    # Machine-readable for harnesses that pass --port 0 (bench.py).
+    print(f"PORT={bound}", flush=True)
     logging.info("hello-service listening on :%d", bound)
     await server.wait_for_termination()
 

@@ -131,6 +131,18 @@ class SessionManager:
                 return None
             return sess
 
+    def get_live(self, session_id: str) -> Optional[SessionContext]:
+        """Hot-path lookup: resolve + touch in one lock acquisition.
+        Headers keep their creation-time snapshot (manager.go:69-84
+        stores headers only when the session is minted)."""
+        self._maybe_cleanup()
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            if sess is None or self._expired(sess):
+                return None
+        sess.touch()
+        return sess
+
     def delete(self, session_id: str) -> bool:
         with self._lock:
             return self._sessions.pop(session_id, None) is not None

@@ -81,7 +81,10 @@ class Gateway:
         await self.discoverer.discover_services()
         self.discoverer.start_watchdog()
 
-        self._runner = web.AppRunner(self.app)
+        # access_log=None: the fused middleware already logs requests;
+        # aiohttp's default access logger would format+emit a second
+        # line per request on the hot path.
+        self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
         self._site = web.TCPSite(
             self._runner, self.cfg.server.host, self.cfg.server.port

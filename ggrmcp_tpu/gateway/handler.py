@@ -419,13 +419,20 @@ class MCPHandler:
         return headers
 
     def _session_for(self, request: web.Request) -> SessionContext:
-        """Resolve/mint the session from Mcp-Session-Id; ALL header
-        values are captured (multi-value fix)."""
+        """Resolve/mint the session from Mcp-Session-Id. Headers are
+        snapshotted once at session creation (manager.go:69-84 parity);
+        ALL values of multi-valued headers are captured (multi-value
+        fix). Resolving an existing session skips the capture entirely —
+        it is pure per-request overhead on the hot path."""
+        sid = request.headers.get(SESSION_HEADER, "")
+        if sid:
+            sess = self.sessions.get_live(sid)
+            if sess is not None:
+                return sess
         raw_headers: dict[str, Any] = {}
         for key in set(request.headers.keys()):
             values = request.headers.getall(key)
             raw_headers[key] = values[0] if len(values) == 1 else list(values)
-        sid = request.headers.get(SESSION_HEADER, "")
         return self.sessions.get_or_create(sid, raw_headers)
 
     def _error(

@@ -90,30 +90,42 @@ class GatewayMetrics:
             ["scope"],  # global | session
             registry=self.registry,
         )
+        # labels() re-validates and re-hashes label values every call
+        # (~6 µs each, ×5 per request); label children are cached here.
+        # Cardinality is bounded by tool/method/status counts.
+        self._children: dict[tuple, object] = {}
 
     # -- recording helpers (no-ops without prometheus) ----------------------
+
+    def _child(self, metric, *labels):
+        key = (id(metric), *labels)
+        child = self._children.get(key)
+        if child is None:
+            child = metric.labels(*labels)
+            self._children[key] = child
+        return child
 
     def observe_http(self, method: str, path: str, status: int, seconds: float):
         if self.registry is None:
             return
-        self.http_requests.labels(method, path, str(status)).inc()
-        self.http_latency.labels(path).observe(seconds)
+        self._child(self.http_requests, method, path, str(status)).inc()
+        self._child(self.http_latency, path).observe(seconds)
 
     def observe_rpc(self, rpc_method: str, outcome: str):
         if self.registry is None:
             return
-        self.rpc_requests.labels(rpc_method, outcome).inc()
+        self._child(self.rpc_requests, rpc_method, outcome).inc()
 
     def observe_tool_call(self, tool: str, outcome: str, seconds: float):
         if self.registry is None:
             return
-        self.tool_calls.labels(tool, outcome).inc()
-        self.tool_latency.labels(tool).observe(seconds)
+        self._child(self.tool_calls, tool, outcome).inc()
+        self._child(self.tool_latency, tool).observe(seconds)
 
     def rate_limit_hit(self, scope: str):
         if self.registry is None:
             return
-        self.rate_limited.labels(scope).inc()
+        self._child(self.rate_limited, scope).inc()
 
     def set_gauges(self, sessions: int, healthy_backends: int):
         if self.registry is None:

@@ -79,18 +79,58 @@ class Validator:
     # -- structural limits --------------------------------------------------
 
     def validate_value(self, value: Any) -> None:
-        depth = _depth(value, self.cfg.max_nesting_depth + 1)
+        try:
+            depth, size = _walk(value)
+        except RecursionError:
+            raise MCPError(
+                INVALID_PARAMS,
+                f"params nesting exceeds depth limit {self.cfg.max_nesting_depth}",
+            )
         if depth > self.cfg.max_nesting_depth:
             raise MCPError(
                 INVALID_PARAMS,
                 f"params nesting exceeds depth limit {self.cfg.max_nesting_depth}",
             )
-        size = _approx_size(value)
         if size > self.cfg.max_request_bytes:
             raise MCPError(
                 INVALID_PARAMS,
                 f"params size {size} exceeds limit {self.cfg.max_request_bytes}",
             )
+
+
+def _walk(value: Any) -> tuple[int, int]:
+    """Depth and approximate serialized size in ONE recursive pass
+    (the hot path validates every tools/call argument tree; two
+    separate walks doubled the cost)."""
+    if isinstance(value, str):
+        return 0, len(value) + 2
+    if isinstance(value, bool) or value is None:
+        return 0, 5
+    if isinstance(value, (int, float)):
+        return 0, 16
+    if isinstance(value, dict):
+        if not value:
+            return 1, 2
+        depth = 0
+        size = 2
+        for k, v in value.items():
+            d, s = _walk(v)
+            if d > depth:
+                depth = d
+            size += len(str(k)) + 4 + s
+        return 1 + depth, size
+    if isinstance(value, (list, tuple)):
+        if not value:
+            return 1, 2
+        depth = 0
+        size = 2
+        for v in value:
+            d, s = _walk(v)
+            if d > depth:
+                depth = d
+            size += s + 1
+        return 1 + depth, size
+    return 0, 16
 
 
 def _depth(value: Any, limit: int) -> int:

@@ -71,6 +71,10 @@ class Backend:
         if self.reflection is None:
             raise ConnectionError(f"backend {self.target} not connected")
         methods, comments = await self.reflection.discover_methods()
+        if self.invoker is not None:
+            # New discovery pass may carry a fresh descriptor pool;
+            # stale cache entries would pin the old one forever.
+            self.invoker.invalidate_cache()
         self.methods = methods
         self.comments = comments
         self.last_discovery = time.time()

@@ -206,6 +206,19 @@ class DynamicInvoker:
 
     def __init__(self, channel: grpc.aio.Channel):
         self._channel = channel
+        # Hot-path cache: building a multicallable and resolving message
+        # classes per call costs more than the transcode itself (SURVEY
+        # §3.3 hot loop). Keyed by (full name, descriptor identity) so a
+        # rediscovery that rebuilds descriptors repopulates naturally.
+        self._unary_cache: dict[tuple, tuple] = {}
+        self._stream_cache: dict[tuple, tuple] = {}
+
+    def invalidate_cache(self) -> None:
+        """Drop cached message classes/multicallables. Called on
+        rediscovery: a rebuilt descriptor pool would otherwise leave
+        stale entries pinning the whole previous pool in memory."""
+        self._unary_cache.clear()
+        self._stream_cache.clear()
 
     def _message_classes(self, method: MethodInfo):
         if method.input_descriptor is None or method.output_descriptor is None:
@@ -214,13 +227,33 @@ class DynamicInvoker:
         resp_cls = message_factory.GetMessageClass(method.output_descriptor)
         return req_cls, resp_cls
 
-    def _build_request(self, method: MethodInfo, arguments: dict[str, Any]):
-        req_cls, resp_cls = self._message_classes(method)
-        request = req_cls()
-        # protojson-equivalent parse; unknown fields are an error, like
-        # the reference's protojson.Unmarshal (reflection.go:351-359).
-        json_format.ParseDict(arguments, request)
-        return request, resp_cls
+    def _unary_entry(self, method: MethodInfo):
+        key = (method.full_name, id(method.input_descriptor))
+        entry = self._unary_cache.get(key)
+        if entry is None:
+            req_cls, resp_cls = self._message_classes(method)
+            callable_ = self._channel.unary_unary(
+                method.grpc_path,
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString,
+            )
+            entry = (req_cls, callable_)
+            self._unary_cache[key] = entry
+        return entry
+
+    def _stream_entry(self, method: MethodInfo):
+        key = (method.full_name, id(method.input_descriptor))
+        entry = self._stream_cache.get(key)
+        if entry is None:
+            req_cls, resp_cls = self._message_classes(method)
+            callable_ = self._channel.unary_stream(
+                method.grpc_path,
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString,
+            )
+            entry = (req_cls, callable_)
+            self._stream_cache[key] = entry
+        return entry
 
     async def invoke(
         self,
@@ -230,12 +263,11 @@ class DynamicInvoker:
         timeout_s: Optional[float] = None,
     ) -> dict[str, Any]:
         """Unary call: JSON dict in → JSON dict out."""
-        request, resp_cls = self._build_request(method, arguments)
-        call = self._channel.unary_unary(
-            method.grpc_path,
-            request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=resp_cls.FromString,
-        )
+        req_cls, call = self._unary_entry(method)
+        request = req_cls()
+        # protojson-equivalent parse; unknown fields are an error, like
+        # the reference's protojson.Unmarshal (reflection.go:351-359).
+        json_format.ParseDict(arguments, request)
         response = await call(
             request, metadata=headers or None, timeout=timeout_s
         )
@@ -253,12 +285,10 @@ class DynamicInvoker:
         """Server-streaming call: yields one JSON dict per message — the
         capability the reference lacked (discovery.go:353-356 rejected
         all streaming), feeding the MCP streaming path."""
-        request, resp_cls = self._build_request(method, arguments)
-        call = self._channel.unary_stream(
-            method.grpc_path,
-            request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=resp_cls.FromString,
-        )(request, metadata=headers or None, timeout=timeout_s)
+        req_cls, stream_callable = self._stream_entry(method)
+        request = req_cls()
+        json_format.ParseDict(arguments, request)
+        call = stream_callable(request, metadata=headers or None, timeout=timeout_s)
         async for response in call:
             yield json_format.MessageToDict(
                 response, preserving_proto_field_name=False

@@ -1,0 +1,112 @@
+"""HTTP load generator for gateway benchmarks.
+
+Runs MCP tools/call traffic against a gateway from a SEPARATE process so
+the gateway's event loop is not competing with the load generator for
+the GIL (the round-1 proxy bench ran client+gateway+backend on one loop,
+understating gateway capacity).
+
+Protocol with the parent (bench.py):
+  1. loadgen connects, performs warmup calls, prints "READY" on stdout.
+  2. Parent writes "GO\n" on stdin once all generators are ready.
+  3. loadgen blasts its sessions, then prints one JSON line:
+     {"start": t0, "end": t1, "count": N, "latencies_ms": [...]}
+
+Timestamps are time.time() so the parent can union windows across
+processes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+
+async def run(args: argparse.Namespace) -> dict:
+    import aiohttp
+
+    # Pre-serialize once: on a single-core host the load generator's own
+    # CPU cost competes with the gateway under test, so the client path
+    # must be as thin as possible. JSON-RPC ids may repeat; the gateway
+    # treats each POST independently.
+    body_bytes = json.dumps({
+        "jsonrpc": "2.0",
+        "method": "tools/call",
+        "id": 1,
+        "params": {"name": args.tool, "arguments": json.loads(args.arguments)},
+    }).encode()
+    post_headers = {"Content-Type": "application/json"}
+    latencies: list[float] = []
+
+    conn = aiohttp.TCPConnector(limit=0)
+    async with aiohttp.ClientSession(
+        base_url=args.base_url, connector=conn
+    ) as client:
+
+        async def one_call(
+            record: bool, session_headers: dict[str, str]
+        ) -> None:
+            t = time.perf_counter()
+            async with client.post(
+                "/", data=body_bytes, headers={**post_headers, **session_headers}
+            ) as resp:
+                payload = await resp.read()
+            if resp.status != 200 or b'"error"' in payload:
+                raise RuntimeError(
+                    f"call failed ({resp.status}): {payload[:200]!r}"
+                )
+            # Reuse the session like a real MCP client: the echoed id
+            # rides every subsequent call (steady-state hot path, not
+            # per-call session minting).
+            sid = resp.headers.get("Mcp-Session-Id")
+            if sid:
+                session_headers["Mcp-Session-Id"] = sid
+            if record:
+                latencies.append((time.perf_counter() - t) * 1000.0)
+
+        for _ in range(args.warmup):
+            await one_call(False, {})
+
+        print("READY", flush=True)
+        line = await asyncio.get_running_loop().run_in_executor(
+            None, sys.stdin.readline
+        )
+        if line.strip() != "GO":
+            raise RuntimeError(f"expected GO, got {line!r}")
+
+        async def session_worker(sid: int) -> None:
+            session_headers: dict[str, str] = {}
+            for _ in range(args.calls_per_session):
+                await one_call(True, session_headers)
+
+        start = time.time()
+        await asyncio.gather(
+            *(session_worker(s) for s in range(args.sessions))
+        )
+        end = time.time()
+
+    return {
+        "start": start,
+        "end": end,
+        "count": len(latencies),
+        "latencies_ms": latencies,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--base-url", required=True)
+    parser.add_argument("--tool", required=True)
+    parser.add_argument("--arguments", default="{}")
+    parser.add_argument("--sessions", type=int, default=8)
+    parser.add_argument("--calls-per-session", type=int, default=50)
+    parser.add_argument("--warmup", type=int, default=2)
+    args = parser.parse_args()
+    result = asyncio.run(run(args))
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -317,3 +317,91 @@ class TestRateLimit:
                 resp = await client.get("/health")
                 statuses.append(resp.status)
             assert 429 in statuses
+
+
+class TestFusedChainEquivalence:
+    """The fused middleware must stay behaviorally identical to the
+    composed factory chain (middleware.py keeps both; divergence here
+    is a bug — a round-2 review found the OPTIONS/rate-limit order had
+    already drifted once)."""
+
+    @staticmethod
+    def _chained_app_middlewares(cfg, metrics):
+        from ggrmcp_tpu.gateway import middleware as mw
+
+        return [
+            mw.recovery_middleware(),
+            mw.logging_middleware(),
+            mw.security_headers_middleware(cfg.server),
+            mw.cors_middleware(cfg.server),
+            mw.rate_limit_middleware(cfg.server, metrics),
+            mw.content_type_middleware(cfg.server),
+            mw.request_size_middleware(cfg.server),
+            mw.timeout_middleware(cfg.server),
+            mw.metrics_middleware(metrics),
+        ]
+
+    async def _probe(self, client):
+        """Drive one request per middleware concern; return comparable
+        (status, relevant-headers, body-error-code) tuples."""
+        out = []
+        # normal call
+        resp = await rpc(client, "tools/call",
+                         {"name": "hello_helloservice_sayhello",
+                          "arguments": {"name": "eq"}})
+        body = await resp.json()
+        out.append(("call", resp.status, "error" in body,
+                    resp.headers.get("X-Content-Type-Options"),
+                    resp.headers.get("Access-Control-Allow-Origin")))
+        # CORS preflight
+        resp = await client.options("/", headers={"Origin": "http://x"})
+        out.append(("options", resp.status,
+                    resp.headers.get("Access-Control-Allow-Methods")))
+        # wrong content type
+        resp = await client.post("/", data=b"{}",
+                                 headers={"Content-Type": "text/plain"})
+        out.append(("ctype", resp.status))
+        # oversize body
+        resp = await client.post(
+            "/", data=b"x" * (2 * 1024 * 1024),
+            headers={"Content-Type": "application/json"})
+        out.append(("oversize", resp.status))
+        # parse error passes through middleware to handler
+        resp = await client.post("/", data=b"{nope",
+                                 headers={"Content-Type": "application/json"})
+        body = await resp.json()
+        out.append(("parse", resp.status, body["error"]["code"]))
+        return out
+
+    async def test_fused_equals_chain(self):
+        from ggrmcp_tpu.gateway import middleware as mwmod
+
+        cfg = gateway_config()
+        cfg.server.max_request_bytes = 1024 * 1024
+        results = {}
+        for mode in ("fused", "chain"):
+            orig = mwmod.default_middlewares
+            if mode == "chain":
+                mwmod.default_middlewares = (
+                    lambda c, m: self._chained_app_middlewares(cfg, m)
+                )
+            try:
+                async with gateway_env(cfg) as (_, _gw, client):
+                    results[mode] = await self._probe(client)
+            finally:
+                mwmod.default_middlewares = orig
+        assert results["fused"] == results["chain"]
+
+    async def test_options_does_not_consume_rate_tokens(self):
+        """Preflights short-circuit before the rate limiter in both
+        variants (cors at position 4, rate limit at 5)."""
+        cfg = gateway_config()
+        cfg.server.rate_limit.requests_per_second = 0.001
+        cfg.server.rate_limit.burst = 1
+        async with gateway_env(cfg) as (_, _gw, client):
+            for _ in range(5):
+                resp = await client.options("/", headers={"Origin": "http://x"})
+                assert resp.status == 204
+            # the single burst token is still available for a real call
+            resp = await client.get("/health")
+            assert resp.status == 200
