@@ -269,6 +269,12 @@ class BatchingConfig:
     # so keep it small; 1 = the classic one-call-per-token loop (best
     # for CPU test meshes, where compute dominates the round-trip).
     decode_steps_per_tick: int = 1
+    # Length-tiered KV cache: [[max_seq, slots], ...] ascending by
+    # max_seq. Empty = one contiguous pool of max_batch_size ×
+    # kv_cache_max_seq. With tiers, HBM is Σ slots×seq and admission
+    # routes each request to the smallest tier that fits it
+    # (serving/tiered.py).
+    kv_tiers: list = field(default_factory=list)
 
 
 @dataclass
@@ -303,6 +309,11 @@ class ServingConfig:
     port: int = 50051
     # Orbax checkpoint directory with model params (empty → random init).
     checkpoint_path: str = ""
+    # HuggingFace Llama checkpoint directory (config.json +
+    # *.safetensors). When set, the model architecture comes from the
+    # checkpoint's config.json and `model` is ignored
+    # (serving/weights.py). Mutually exclusive with checkpoint_path.
+    hf_checkpoint_path: str = ""
     # HuggingFace tokenizer.json path (empty → hermetic byte tokenizer).
     tokenizer_path: str = ""
     # Weight quantization for decoder serving: "" (off) or "int8"
@@ -317,6 +328,13 @@ class ServingConfig:
     # traffic, not for saturation workloads.
     speculative_draft: str = ""
     speculative_gamma: int = 4
+    # Sequence-parallel prefill over the mesh `sequence` axis: "ring"
+    # (ppermute K/V rotation) or "ulysses" (all_to_all head re-shard);
+    # "" disables. Engages for fresh prefills of at least
+    # sp_prefill_min_seq tokens when the sequence axis is > 1
+    # (serving/engine.py::prefill_forward, SURVEY §5.7).
+    sp_prefill: str = "ring"
+    sp_prefill_min_seq: int = 1024
     # Orbax checkpoint for the draft's params (empty → random init).
     speculative_draft_checkpoint: str = ""
 
@@ -378,6 +396,17 @@ class Config:
             raise ValueError("descriptor set enabled but no path given")
         if self.serving.batching.decode_steps_per_tick < 1:
             raise ValueError("decode_steps_per_tick must be >= 1")
+        if (
+            self.serving.batching.decode_steps_per_tick
+            >= self.serving.batching.kv_cache_max_seq
+        ):
+            # The batcher reserves steps_per_tick-1 cache positions for
+            # tick overshoot; at >= max_seq the admissible request size
+            # degenerates to nothing and overshoot can clamp-write at
+            # the cache tail.
+            raise ValueError(
+                "decode_steps_per_tick must be < batching.kv_cache_max_seq"
+            )
         if self.serving.speculative_gamma < 1:
             raise ValueError("speculative_gamma must be >= 1")
         if self.training.steps < 1 or self.training.batch_size < 1:
@@ -387,6 +416,37 @@ class Config:
         if self.training.log_every_steps < 1 or self.training.save_every_steps < 1:
             raise ValueError(
                 "training log_every_steps/save_every_steps must be >= 1"
+            )
+        if self.serving.checkpoint_path and self.serving.hf_checkpoint_path:
+            raise ValueError(
+                "checkpoint_path and hf_checkpoint_path are mutually "
+                "exclusive (Orbax vs HuggingFace format)"
+            )
+        tiers = self.serving.batching.kv_tiers
+        if tiers:
+            if not all(
+                isinstance(t, (list, tuple)) and len(t) == 2
+                and int(t[0]) > 0 and int(t[1]) > 0
+                for t in tiers
+            ):
+                raise ValueError(
+                    "batching.kv_tiers entries must be [max_seq, slots] "
+                    "pairs of positive ints"
+                )
+            seqs = [int(t[0]) for t in tiers]
+            if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+                raise ValueError(
+                    "batching.kv_tiers must be strictly ascending by max_seq"
+                )
+            if self.serving.batching.decode_steps_per_tick >= seqs[0]:
+                raise ValueError(
+                    "decode_steps_per_tick must be < the smallest tier's "
+                    "max_seq"
+                )
+        if self.serving.sp_prefill not in ("", "ring", "ulysses"):
+            raise ValueError(
+                f"unknown serving.sp_prefill {self.serving.sp_prefill!r}; "
+                f"supported: 'ring', 'ulysses'"
             )
         if self.serving.quantize not in ("", "int8"):
             # Catch typos at parse time, before minutes of checkpoint

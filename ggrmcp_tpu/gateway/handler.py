@@ -321,7 +321,15 @@ class MCPHandler:
                 tool_name, "client_disconnect", time.perf_counter() - start
             )
             return response
-        except (grpc.RpcError, grpc.aio.UsageError, ConnectionError) as exc:
+        except ConnectionError as exc:
+            # Same outcome label as the unary path, so per-outcome
+            # dashboards agree across transports.
+            outcome = "unavailable"
+            final = mcp.make_response(
+                request_id,
+                mcp.tool_call_error(sanitize_error(f"backend unavailable: {exc}")),
+            )
+        except (grpc.RpcError, grpc.aio.UsageError) as exc:
             outcome = "backend_error"
             if isinstance(exc, grpc.aio.AioRpcError):
                 message = f"gRPC call failed ({exc.code().name}): {exc.details()}"

@@ -222,11 +222,15 @@ def fused_middleware(cfg: ServerConfig, metrics: GatewayMetrics) -> Callable:
 
     Nine stacked aiohttp middlewares cost nine coroutine frames +
     scheduling per request; at gateway throughput targets (≥1k calls/s)
-    that overhead is measurable (SURVEY §3.3). Semantics are identical
-    to the individual factories below, in the same order: recovery →
-    logging → security headers → CORS → global rate limit →
-    content-type → size cap → timeout → metrics. The individual
-    factories remain exported for tests and custom chains."""
+    that overhead is measurable (SURVEY §3.3). Response semantics are
+    identical to the individual factories below, in the same order:
+    recovery → logging → security headers → CORS → global rate limit →
+    content-type → size cap → timeout → metrics. One DELIBERATE
+    difference: metrics cover every response, including short-circuited
+    ones (429/415/413/preflight/recovery-500) that the unfused chain's
+    innermost metrics middleware never saw — error-rate dashboards see
+    the full truth here. The individual factories remain exported for
+    tests and custom chains."""
     bucket = TokenBucket(cfg.rate_limit.requests_per_second, cfg.rate_limit.burst)
     allowed_ctypes = tuple(cfg.allowed_content_types)
     sec = cfg.security

@@ -133,36 +133,6 @@ def _walk(value: Any) -> tuple[int, int]:
     return 0, 16
 
 
-def _depth(value: Any, limit: int) -> int:
-    """Depth of nested containers, short-circuiting once past `limit`."""
-    if limit <= 0:
-        return 1
-    if isinstance(value, dict):
-        if not value:
-            return 1
-        return 1 + max(_depth(v, limit - 1) for v in value.values())
-    if isinstance(value, (list, tuple)):
-        if not value:
-            return 1
-        return 1 + max(_depth(v, limit - 1) for v in value)
-    return 0
-
-
-def _approx_size(value: Any) -> int:
-    """Approximate serialized size without serializing (validation.go:187)."""
-    if isinstance(value, str):
-        return len(value) + 2
-    if isinstance(value, bool) or value is None:
-        return 5
-    if isinstance(value, (int, float)):
-        return 16
-    if isinstance(value, dict):
-        return 2 + sum(len(str(k)) + 4 + _approx_size(v) for k, v in value.items())
-    if isinstance(value, (list, tuple)):
-        return 2 + sum(_approx_size(v) + 1 for v in value)
-    return 16
-
-
 # ---------------------------------------------------------------------------
 # Sanitization
 # ---------------------------------------------------------------------------

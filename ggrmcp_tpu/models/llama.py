@@ -172,9 +172,22 @@ def attention_block(
     cache_k: Optional[jnp.ndarray],  # [B, S_max, KVH, Dh]
     cache_v: Optional[jnp.ndarray],
     cache_len: Optional[jnp.ndarray],  # [B]
+    use_flash: Optional[bool] = None,
+    attn_impl: Optional[Any] = None,
 ):
     """Pre-norm GQA attention with residual; shared by the dense and MoE
-    decoder families. Returns (x + attn, (cache_k, cache_v) or None)."""
+    decoder families. Returns (x + attn, (cache_k, cache_v) or None).
+    K/V keep their KV heads — GQA lives in ops.attention (the flash
+    kernel reads shared heads in place; the XLA path repeats them).
+
+    `attn_impl`: optional attention callable `(q, k, v, causal) -> out`
+    over the CURRENT chunk's keys only — the sequence-parallel
+    (ring/Ulysses) prefill hook. Valid ONLY for fresh prefill
+    (cache_len == 0 and the cache sized exactly to this chunk): then
+    cache attention over the written prefix equals plain causal
+    attention over the chunk, and per-row pad keys only influence pad
+    queries whose outputs are discarded. The engine gates this
+    (serving/engine.py::prefill_forward)."""
     b, s, d = x.shape
     h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
@@ -203,15 +216,22 @@ def attention_block(
     else:
         k_all, v_all, kv_len, q_offset = k, v, None, None
 
-    # GQA: repeat KV heads to match query heads.
-    if kvh != h:
-        reps = h // kvh
-        k_all = jnp.repeat(k_all, reps, axis=2)
-        v_all = jnp.repeat(v_all, reps, axis=2)
-
-    attn_out = attention(
-        q, k_all, v_all, causal=True, q_offset=q_offset, kv_len=kv_len
-    )
+    if attn_impl is not None:
+        # Sequence-parallel fresh-prefill: attend over this chunk's
+        # keys (contract above). Ring/Ulysses expect equal head counts.
+        if kvh != h:
+            reps = h // kvh
+            attn_out = attn_impl(
+                q, jnp.repeat(k, reps, axis=2), jnp.repeat(v, reps, axis=2),
+                causal=True,
+            )
+        else:
+            attn_out = attn_impl(q, k, v, causal=True)
+    else:
+        attn_out = attention(
+            q, k_all, v_all, causal=True, q_offset=q_offset, kv_len=kv_len,
+            use_flash=use_flash,
+        )
     attn_out = qmatmul(attn_out.reshape(b, s, h * hd), layer_params["wo"])
     x = x + attn_out
 
@@ -228,9 +248,12 @@ def _layer(
     cache_k: Optional[jnp.ndarray],
     cache_v: Optional[jnp.ndarray],
     cache_len: Optional[jnp.ndarray],
+    use_flash: Optional[bool] = None,
+    attn_impl: Optional[Any] = None,
 ):
     x, new_cache = attention_block(
-        x, layer_params, cfg, positions, cache_k, cache_v, cache_len
+        x, layer_params, cfg, positions, cache_k, cache_v, cache_len,
+        use_flash=use_flash, attn_impl=attn_impl,
     )
 
     # SwiGLU MLP
@@ -247,11 +270,17 @@ def forward(
     cfg: LlamaConfig,
     tokens: jnp.ndarray,  # [B, S]
     cache: Optional[KVCache] = None,
+    use_flash: Optional[bool] = None,
+    attn_impl: Optional[Any] = None,
 ) -> tuple[jnp.ndarray, Optional[KVCache]]:
     """Run the decoder. Without a cache: plain causal forward (training/
     scoring). With a cache: serving — tokens are appended at each
     sequence's cache length (prefill S>1, decode S=1), the cache is
     updated functionally, and logits cover the new positions.
+
+    `use_flash`: None = auto (ops.attention decides per shape/platform);
+    False forces the XLA path (multi-device meshes — see ops/attention).
+    `attn_impl`: sequence-parallel fresh-prefill hook (attention_block).
 
     Returns (logits [B, S, V], updated cache or None).
     """
@@ -268,7 +297,10 @@ def forward(
     if cache is None:
 
         def body(x, layer_params):
-            x, _ = _layer(x, layer_params, cfg, positions, None, None, None)
+            x, _ = _layer(
+                x, layer_params, cfg, positions, None, None, None,
+                use_flash=use_flash, attn_impl=attn_impl,
+            )
             return x, None
 
         x, _ = jax.lax.scan(body, x, layers)
@@ -278,7 +310,8 @@ def forward(
         def body(x, scanned):
             layer_params, ck, cv = scanned
             x, (ck, cv) = _layer(
-                x, layer_params, cfg, positions, ck, cv, cache.length
+                x, layer_params, cfg, positions, ck, cv, cache.length,
+                use_flash=use_flash, attn_impl=attn_impl,
             )
             return x, (ck, cv)
 

@@ -248,10 +248,12 @@ def moe_ffn(
 
 
 def _layer(
-    x, layer_params, cfg, positions, cache_k, cache_v, cache_len, valid
+    x, layer_params, cfg, positions, cache_k, cache_v, cache_len, valid,
+    use_flash=None,
 ):
     x, new_cache = attention_block(
-        x, layer_params, cfg, positions, cache_k, cache_v, cache_len
+        x, layer_params, cfg, positions, cache_k, cache_v, cache_len,
+        use_flash=use_flash,
     )
     normed = common.rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
     ffn_out, aux = moe_ffn(normed, layer_params, cfg, valid)
@@ -264,11 +266,14 @@ def forward(
     tokens: jnp.ndarray,  # [B, S]
     cache: Optional[KVCache] = None,
     valid: Optional[jnp.ndarray] = None,  # [B, S] bool
+    use_flash: Optional[bool] = None,
 ) -> tuple[jnp.ndarray, Optional[KVCache]]:
     """Same contract as `llama.forward` — the engines treat both
     families interchangeably. `valid` marks real (non-padding) tokens
     so padding never competes for expert capacity."""
-    logits, cache, _ = forward_with_aux(params, cfg, tokens, cache, valid)
+    logits, cache, _ = forward_with_aux(
+        params, cfg, tokens, cache, valid, use_flash=use_flash
+    )
     return logits, cache
 
 
@@ -278,6 +283,7 @@ def forward_with_aux(
     tokens: jnp.ndarray,
     cache: Optional[KVCache] = None,
     valid: Optional[jnp.ndarray] = None,
+    use_flash: Optional[bool] = None,
 ) -> tuple[jnp.ndarray, Optional[KVCache], jnp.ndarray]:
     """Forward returning the mean router load-balance loss (training)."""
     b, s = tokens.shape
@@ -294,7 +300,8 @@ def forward_with_aux(
 
         def body(x, layer_params):
             x, _, aux = _layer(
-                x, layer_params, cfg, positions, None, None, None, valid
+                x, layer_params, cfg, positions, None, None, None, valid,
+                use_flash=use_flash,
             )
             return x, aux
 
@@ -305,7 +312,8 @@ def forward_with_aux(
         def body(x, scanned):
             layer_params, ck, cv = scanned
             x, (ck, cv), aux = _layer(
-                x, layer_params, cfg, positions, ck, cv, cache.length, valid
+                x, layer_params, cfg, positions, ck, cv, cache.length, valid,
+                use_flash=use_flash,
             )
             return x, ((ck, cv), aux)
 

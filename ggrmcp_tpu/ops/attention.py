@@ -9,14 +9,22 @@ Two implementations with one contract:
 - `flash_attention` — blockwise online-softmax Pallas kernel (the
   standard FlashAttention recurrence) that never materializes the
   [S, S] score matrix, keeping HBM traffic linear in sequence length.
-  Grid: (batch*heads, q_blocks); the kernel loops over k blocks with
-  running max/denominator in VMEM scratch. Causal masking skips fully
-  masked k blocks. Falls back to interpret mode off-TPU so the same
-  code path is unit-tested on the CPU mesh.
+  Grid: (batch, q_heads, q_blocks); the kernel loops over k blocks with
+  running max/denominator carried in registers. Per-batch `q_offset`
+  (absolute position of q[0], for cached prefill) and `kv_len` (valid
+  cache prefix) ride in SMEM, so the SERVING prefill path — where the
+  KV cache supplies both — can use the kernel, not just the cache-free
+  training/scoring forward. GQA is native: K/V keep their (fewer) KV
+  heads and the grid's head index maps onto the shared KV head, so
+  repeated K/V never hit HBM. Causal masking skips fully masked
+  k blocks; `kv_len` bounds the k loop per batch. Falls back to
+  interpret mode off-TPU so the same code path is unit-tested on the
+  CPU mesh.
 
-`attention` picks per call: flash for long prefill on TPU, XLA
-otherwise. Shapes are [batch, seq, heads, head_dim] throughout; GQA is
-handled by repeating KV heads outside (models pass num_kv_heads).
+`attention` picks per call: flash for long prefill on TPU (measured
+crossover — see docs/perf_attention.md), XLA otherwise. Shapes are
+[batch, seq, heads, head_dim]; K/V may carry fewer (KV) heads, the
+dispatcher repeats them only for the XLA path.
 """
 
 from __future__ import annotations
@@ -27,6 +35,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -77,6 +86,8 @@ def attention_xla(
 
 
 def _flash_kernel(
+    q_off_ref,  # SMEM [B] int32 — absolute position of q[0] per batch
+    kv_len_ref,  # SMEM [B] int32 — valid kv prefix per batch
     q_ref,  # [block_q, D]
     k_ref,  # [Sk, D]
     v_ref,  # [Sk, D]
@@ -87,9 +98,11 @@ def _flash_kernel(
     causal: bool,
     block_q: int,
 ):
-    """One (batch*head, q_block) cell: online-softmax over k blocks."""
-    q_block_idx = pl.program_id(1)
-    q_start = q_block_idx * block_q
+    """One (batch, head, q_block) cell: online-softmax over k blocks."""
+    b_idx = pl.program_id(0)
+    q_start = pl.program_id(2) * block_q
+    q_off = q_off_ref[b_idx]
+    limit = kv_len_ref[b_idx]  # keys at position >= limit are invalid
 
     q = q_ref[:].astype(jnp.float32)  # [bq, D]
     scale = q.shape[-1] ** -0.5
@@ -99,13 +112,14 @@ def _flash_kernel(
     l0 = jnp.zeros((block_q, 1), dtype=jnp.float32)
     acc0 = jnp.zeros_like(q)
 
-    num_k_blocks = pl.cdiv(sk, block_k)
+    # Number of k blocks that can contain a valid key for this q block:
+    # bounded by the batch's kv_len, and under causality by the last
+    # query's absolute position.
+    kv_limit = limit
     if causal:
-        # Last k block that can contain unmasked keys for this q block.
-        last = (q_start + block_q - 1) // block_k + 1
-        num_iters = jnp.minimum(num_k_blocks, last)
-    else:
-        num_iters = num_k_blocks
+        kv_limit = jnp.minimum(kv_limit, q_off + q_start + block_q)
+    kv_limit = jnp.minimum(kv_limit, sk)
+    num_iters = (kv_limit + block_k - 1) // block_k
 
     def body(kb, carry):
         m_prev, l_prev, acc_prev = carry
@@ -115,14 +129,16 @@ def _flash_kernel(
         scores = jnp.dot(
             q, k_blk.T, preferred_element_type=jnp.float32
         )  # [bq, bk]
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < limit
         if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
+            q_pos = q_off + q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+            mask &= q_pos >= k_pos
+        scores = jnp.where(mask, scores, NEG_INF)
         m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(scores - m_new)
@@ -133,6 +149,10 @@ def _flash_kernel(
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
+    # Fully masked rows (kv_limit == 0) have l == 0; emit zeros. Rows
+    # whose first processed block is fully masked keep m == NEG_INF and
+    # p == exp(0) == 1 — impossible here: causal q_pos >= 0 always
+    # admits k block 0, and kv_limit == 0 skips the loop entirely.
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
@@ -141,46 +161,72 @@ def _flash_kernel(
 )
 def flash_attention(
     q: jnp.ndarray,  # [B, Sq, H, D]
-    k: jnp.ndarray,  # [B, Sk, H, D]
-    v: jnp.ndarray,  # [B, Sk, H, D]
+    k: jnp.ndarray,  # [B, Sk, KVH, D] — KVH may divide H (GQA)
+    v: jnp.ndarray,  # [B, Sk, KVH, D]
     causal: bool = True,
+    q_offset: Optional[jnp.ndarray] = None,  # [B] absolute pos of q[0]
+    kv_len: Optional[jnp.ndarray] = None,  # [B] valid kv prefix
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """FlashAttention over [B, S, H, D]; S must be a multiple of the
-    block sizes (pad upstream). Runs interpreted off-TPU."""
+    block sizes (pad upstream; padded keys are masked out via kv_len).
+    K/V keep their KV heads — the grid maps query head h onto KV head
+    h // (H // KVH), so GQA costs no HBM repeat. Runs interpreted
+    off-TPU."""
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, kvh = k.shape[1], k.shape[2]
+    assert h % kvh == 0, f"q heads {h} not a multiple of kv heads {kvh}"
+    reps = h // kvh
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     assert sq % block_q == 0 and sk % block_k == 0, (
         f"seq lens ({sq},{sk}) must be multiples of blocks ({block_q},{block_k})"
     )
 
-    # [B, S, H, D] → [B*H, S, D] for a flat grid.
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    if q_offset is None:
+        q_offset = jnp.zeros((b,), jnp.int32)
+    if kv_len is None:
+        kv_len = jnp.full((b,), sk, jnp.int32)
+
+    # [B, S, H, D] → [B, H, S, D]: Mosaic wants the squeezed (blocked-
+    # to-1) dims major; the minor two block dims (block_q, d) then meet
+    # the (8, 128)-or-full tiling rule.
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)  # [B, KVH, Sk, D]
+    vh = v.transpose(0, 2, 1, 3)
 
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, sk=sk, causal=causal, block_q=block_q
     )
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // block_q),
+        grid=(b, h, sq // block_q),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # q_offset [B]
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # kv_len [B]
+            pl.BlockSpec(
+                (None, None, block_q, d), lambda bi, hi, qb: (bi, hi, qb, 0)
+            ),
+            pl.BlockSpec(
+                (None, None, sk, d), lambda bi, hi, qb: (bi, hi // reps, 0, 0)
+            ),
+            pl.BlockSpec(
+                (None, None, sk, d), lambda bi, hi, qb: (bi, hi // reps, 0, 0)
+            ),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=pl.BlockSpec(
+            (None, None, block_q, d), lambda bi, hi, qb: (bi, hi, qb, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    )(
+        q_offset.astype(jnp.int32), kv_len.astype(jnp.int32), qh, kh, vh
+    )
+    return out.transpose(0, 2, 1, 3)
 
 
 # ---------------------------------------------------------------------------
@@ -189,29 +235,43 @@ def flash_attention(
 
 # Prefill sequences at least this long go through the Pallas kernel on
 # TPU; below it the fused XLA path wins (kernel launch + padding costs).
+# Set from on-chip measurement — see docs/perf_attention.md.
 FLASH_MIN_SEQ = 256
 
 
 def attention(
-    q: jnp.ndarray,
-    k: jnp.ndarray,
-    v: jnp.ndarray,
+    q: jnp.ndarray,  # [B, Sq, H, D]
+    k: jnp.ndarray,  # [B, Sk, KVH, D] — KVH == H or divides it (GQA)
+    v: jnp.ndarray,  # [B, Sk, KVH, D]
     causal: bool = True,
     q_offset: Optional[jnp.ndarray] = None,
     kv_len: Optional[jnp.ndarray] = None,
     use_flash: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Pick the right implementation for the shapes at hand."""
+    """Pick the right implementation for the shapes at hand. GQA is
+    handled here: the flash kernel reads the shared KV heads in place;
+    the XLA path repeats them (XLA materializes the repeat either way).
+
+    `use_flash=None` means auto: flash for long prefill on a TPU.
+    Engines running on multi-device meshes pass False — the kernel is
+    a custom call GSPMD cannot partition; a shard_map wrapper is the
+    multi-chip path (docs/perf_attention.md)."""
     sq, sk = q.shape[1], k.shape[1]
     if use_flash is None:
         use_flash = (
             jax.devices()[0].platform == "tpu"
-            and q_offset is None
-            and kv_len is None
-            and sq == sk
             and sq >= FLASH_MIN_SEQ
             and sq % 128 == 0
+            and sk % 128 == 0
         )
     if use_flash:
-        return flash_attention(q, k, v, causal=causal)
-    return attention_xla(q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len)
+        return flash_attention(
+            q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len
+        )
+    h, kvh = q.shape[2], k.shape[2]
+    if kvh != h:
+        k = jnp.repeat(k, h // kvh, axis=2)
+        v = jnp.repeat(v, h // kvh, axis=2)
+    return attention_xla(
+        q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len
+    )

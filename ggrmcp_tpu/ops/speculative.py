@@ -47,6 +47,7 @@ def speculative_generate(
     gamma: int,
     eos_id,
     max_new=None,  # traced per-call cap ≤ max_new_budget (None → budget)
+    use_flash=None,  # threaded to forward (False on multi-device meshes)
 ) -> SpecResult:
     """Generate up to `max_new` tokens per row, greedy, speculative.
 
@@ -70,8 +71,12 @@ def speculative_generate(
     dcache = _kv_class(draft_fam).create(draft_cfg, b, budget)
 
     # Prefill both models on the prompt.
-    tlogits, tcache = target_fam.forward(target_params, target_cfg, tokens, tcache)
-    _, dcache = draft_fam.forward(draft_params, draft_cfg, tokens, dcache)
+    tlogits, tcache = target_fam.forward(
+        target_params, target_cfg, tokens, tcache, use_flash=use_flash
+    )
+    _, dcache = draft_fam.forward(
+        draft_params, draft_cfg, tokens, dcache, use_flash=use_flash
+    )
     last_idx = jnp.maximum(true_len - 1, 0)
     first = jnp.argmax(
         jnp.take_along_axis(tlogits, last_idx[:, None, None], axis=1)[:, 0],
@@ -107,14 +112,14 @@ def speculative_generate(
         # cur extends), then gamma-1 single-token steps.
         two = jnp.stack([prev, cur], axis=1)  # [B, 2]
         dlogits, dcache2 = draft_fam.forward(
-            draft_params, draft_cfg, two, dcache
+            draft_params, draft_cfg, two, dcache, use_flash=use_flash
         )
         d1 = jnp.argmax(dlogits[:, -1], axis=-1).astype(jnp.int32)
 
         def draft_step(c, _):
             tok, dc = c
             lg, dc = draft_fam.forward(
-                draft_params, draft_cfg, tok[:, None], dc
+                draft_params, draft_cfg, tok[:, None], dc, use_flash=use_flash
             )
             nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
             return (nxt, dc), nxt
@@ -130,7 +135,7 @@ def speculative_generate(
         # --- target verifies in ONE forward --------------------------
         verify_in = jnp.concatenate([cur[:, None], proposals], axis=1)
         vlogits, tcache2 = target_fam.forward(
-            target_params, target_cfg, verify_in, tcache
+            target_params, target_cfg, verify_in, tcache, use_flash=use_flash
         )
         greedy = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, gamma+1]
         # greedy[:, i] is the target's token AFTER verify_in[:, i]:

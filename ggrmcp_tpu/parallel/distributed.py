@@ -56,6 +56,12 @@ def initialize(
     if coordinator_address is None and num_processes is None:
         logger.info("single-process runtime (no coordinator configured)")
         return False
+    # Backend init happens inside jax.distributed.initialize; make the
+    # operator's JAX_PLATFORMS authoritative FIRST or a plugin platform
+    # (axon) may initialize its own backend and hang (utils/jaxenv.py).
+    from ggrmcp_tpu.utils.jaxenv import apply_platform_env
+
+    apply_platform_env()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

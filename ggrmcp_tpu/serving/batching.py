@@ -81,6 +81,10 @@ class ContinuousBatcher:
         self.eos_id = eos_id
         self.slots = [_Slot() for _ in range(self.cfg.max_batch_size)]
         self.pending: asyncio.Queue[_Request] = asyncio.Queue()
+        # True while a call that donates the SHARED cache is in flight
+        # (set just before, cleared after self.cache is reassigned);
+        # admission-failure handling rebuilds the cache only when set.
+        self._cache_at_risk = False
         self._task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._stopping = False
@@ -131,13 +135,12 @@ class ContinuousBatcher:
         token. Returns (first [R], mini cache)."""
         r, s = tokens.shape
         mini = llama_mod.KVCache.create(self.engine.cfg, r, s)
-        if self._is_moe:
-            valid = jnp.arange(s)[None, :] < true_len[:, None]
-            logits, mini = self.fam.forward(
-                params, self.engine.cfg, tokens, mini, valid=valid
-            )
-        else:
-            logits, mini = self.fam.forward(params, self.engine.cfg, tokens, mini)
+        # Fresh prefill → engine.prefill_forward (handles MoE validity
+        # and the sequence-parallel long-chunk path).
+        valid = jnp.arange(s)[None, :] < true_len[:, None]
+        logits, mini = self.engine.prefill_forward(
+            params, tokens, mini, valid=valid
+        )
         first = self._first_token_impl(
             logits, jnp.maximum(true_len - 1, 0), seeds, temps, ks, ps
         )
@@ -185,11 +188,12 @@ class ContinuousBatcher:
             if self._is_moe:
                 logits, cache = self.fam.forward(
                     self.engine.params, self.engine.cfg, cur[:, None], cache,
-                    valid=active[:, None],
+                    valid=active[:, None], use_flash=self.engine.use_flash,
                 )
             else:
                 logits, cache = self.fam.forward(
-                    self.engine.params, self.engine.cfg, cur[:, None], cache
+                    self.engine.params, self.engine.cfg, cur[:, None], cache,
+                    use_flash=self.engine.use_flash,
                 )
             nxt = sample_dynamic(logits[:, -1], seeds, step + i, temps, ks, ps)
             return (nxt, cache), nxt
@@ -206,10 +210,14 @@ class ContinuousBatcher:
             offset = mini.length[:, None]
             valid = (offset + jnp.arange(tokens.shape[1])[None, :]) < true_len
             logits, mini = self.fam.forward(
-                params, self.engine.cfg, tokens, mini, valid=valid
+                params, self.engine.cfg, tokens, mini, valid=valid,
+                use_flash=self.engine.use_flash,
             )
         else:
-            logits, mini = self.fam.forward(params, self.engine.cfg, tokens, mini)
+            logits, mini = self.fam.forward(
+                params, self.engine.cfg, tokens, mini,
+                use_flash=self.engine.use_flash,
+            )
         return logits, mini
 
     def _insert_row_impl(self, cache, mini, slot, length):
@@ -238,9 +246,16 @@ class ContinuousBatcher:
                 self.engine.params, jnp.asarray(chunk), mini, true_len
             )
         mini = mini._replace(length=jnp.asarray([n], jnp.int32))
+        self._cache_at_risk = True
         self.cache = self._insert_row(
             self.cache, mini, jnp.int32(slot_idx), jnp.int32(n)
         )
+        # Under JAX async dispatch a device failure inside the donating
+        # call surfaces only at materialization — force it BEFORE
+        # declaring the shared cache safe, or the failure handler would
+        # skip the rebuild of a poisoned cache.
+        jax.block_until_ready(self.cache.length)
+        self._cache_at_risk = False
         # Last real token sits at (n-1) % c of the final chunk.
         first = self._first_token(
             logits, jnp.asarray([(n - 1) % c], jnp.int32),
@@ -456,30 +471,45 @@ class ContinuousBatcher:
                     None, self._prefill_into_slots, slots_idx, batch
                 )
             except Exception:
-                # The admit call donated the shared cache, so its
-                # buffers may be dead — rebuild it, which also wipes
-                # every ACTIVE slot's KV rows. Fail the batch AND all
-                # in-flight requests (mirrors the tick-failure path;
-                # anything less would silently stream garbage from the
-                # zeroed cache), but keep the loop alive.
+                # Fail the batch, but scale the blast radius to what
+                # actually broke. Requests from this batch that already
+                # activated (chunked path emits per-request) got their
+                # success chunk — don't queue a second terminal chunk.
+                # The shared cache is rebuilt ONLY if the failing call
+                # was one that donates it (_admit_single/_admit_full/
+                # _insert_row); an exception from _chunk_step only
+                # killed its private mini cache, and nuking every
+                # active slot for it would turn one poisoned prompt
+                # into a full-pool outage.
                 logger.exception(
                     "batched prefill failed for slots %s", slots_idx
                 )
+                cache_dead = self._cache_at_risk
+                activated = {
+                    id(s.request) for s in self.slots
+                    if s.active and s.request is not None
+                }
                 for request in batch:
-                    self._loop_ref.call_soon_threadsafe(
-                        request.out.put_nowait, ([], "error")
-                    )
-                for slot in self.slots:
-                    if slot.active and slot.request is not None:
+                    if id(request) not in activated:
                         self._loop_ref.call_soon_threadsafe(
-                            slot.request.out.put_nowait, ([], "error")
+                            request.out.put_nowait, ([], "error")
                         )
-                    slot.active = False
-                    slot.request = None
-                    slot.done = False
-                self.cache = self.engine.make_cache(
-                    len(self.slots), self.max_seq
-                )
+                if cache_dead:
+                    # The donated buffers are dead: every active slot's
+                    # KV rows go with them (anything less would stream
+                    # garbage from a zeroed cache).
+                    for slot in self.slots:
+                        if slot.active and slot.request is not None:
+                            self._loop_ref.call_soon_threadsafe(
+                                slot.request.out.put_nowait, ([], "error")
+                            )
+                        slot.active = False
+                        slot.request = None
+                        slot.done = False
+                    self.cache = self.engine.make_cache(
+                        len(self.slots), self.max_seq
+                    )
+                    self._cache_at_risk = False
                 continue
             admitted += len(batch)
         return admitted
@@ -532,6 +562,7 @@ class ContinuousBatcher:
             ks[row] = req.sampling.top_k
             ps[row] = req.sampling.top_p
             valid[row] = True
+        self._cache_at_risk = True
         if single:
             first, self.cache = self._admit_single(
                 self.engine.params, jnp.asarray(tokens),
@@ -546,7 +577,11 @@ class ContinuousBatcher:
                 jnp.asarray(seeds), jnp.asarray(temps), jnp.asarray(ks),
                 jnp.asarray(ps),
             )
+        # Materialize BEFORE clearing the at-risk flag: under async
+        # dispatch a device failure in the donating call surfaces here,
+        # and the handler must still see the cache as possibly dead.
         first = np.asarray(first)
+        self._cache_at_risk = False
         for j, (slot_idx, req) in enumerate(zip(slots_idx, batch)):
             self._activate_slot(slot_idx, req, int(first[row_of(j)]))
 

@@ -46,27 +46,53 @@ class Sidecar:
     def __init__(self, serving: Optional[ServingConfig] = None, mesh=None):
         self.serving = serving or ServingConfig()
         self.tokenizer = load_tokenizer(self.serving.tokenizer_path)
-        family, model_cfg = get_model(self.serving.model)
-        self.family = family
         self.generation: Optional[GenerationEngine] = None
         self.embedding: Optional[EmbeddingEngine] = None
         self.batcher: Optional[ContinuousBatcher] = None
         params = None
-        if self.serving.checkpoint_path:
-            from ggrmcp_tpu.serving.checkpoint import restore
+        if self.serving.hf_checkpoint_path:
+            # Real upstream weights: architecture AND params come from
+            # the HF checkpoint (serving/weights.py).
+            from ggrmcp_tpu.serving.weights import load_hf_checkpoint
 
-            params = restore(self.serving.checkpoint_path)
-            logger.info(
-                "restored params from %s", self.serving.checkpoint_path
+            family = "llama"
+            model_cfg, params = load_hf_checkpoint(
+                self.serving.hf_checkpoint_path
             )
+        else:
+            family, model_cfg = get_model(self.serving.model)
+            if self.serving.checkpoint_path:
+                from ggrmcp_tpu.serving.checkpoint import restore
+
+                params = restore(self.serving.checkpoint_path)
+                logger.info(
+                    "restored params from %s", self.serving.checkpoint_path
+                )
+        self.family = family
+        self.spec_batcher = None
         if family in ("llama", "moe"):
             self.generation = GenerationEngine(
                 model_cfg, self.serving, mesh=mesh, params=params
             )
-            self.batcher = ContinuousBatcher(
-                self.generation, self.serving.batching,
-                eos_id=self.tokenizer.eos_id,
-            )
+            if self.serving.batching.kv_tiers:
+                from ggrmcp_tpu.serving.tiered import TieredBatcher
+
+                self.batcher = TieredBatcher(
+                    self.generation, self.serving.batching,
+                    eos_id=self.tokenizer.eos_id,
+                )
+            else:
+                self.batcher = ContinuousBatcher(
+                    self.generation, self.serving.batching,
+                    eos_id=self.tokenizer.eos_id,
+                )
+            if self.generation.draft_fam is not None:
+                from ggrmcp_tpu.serving.spec_batcher import SpeculativeBatcher
+
+                self.spec_batcher = SpeculativeBatcher(
+                    self.generation, self.serving.batching,
+                    eos_id=self.tokenizer.eos_id,
+                )
         else:
             self.embedding = EmbeddingEngine(
                 model_cfg, self.serving, mesh=mesh, params=params
@@ -171,18 +197,15 @@ class Sidecar:
             model=self.generation.cfg.name, prompt_tokens=len(prompt),
         ) as span:
             if speculative:
-                # Greedy + draft configured → lossless speculative path
-                # (one fused device program; see ops/speculative.py).
-                loop = asyncio.get_running_loop()
+                # Greedy + draft configured → lossless speculative path.
+                # Concurrent requests are micro-batched into ONE
+                # multi-row device program (serving/spec_batcher.py), so
+                # a configured draft no longer serializes greedy traffic
+                # one private program at a time.
                 try:
-                    outs, reasons, stats = await loop.run_in_executor(
-                        None,
-                        lambda: self.generation.generate_speculative(
-                            [prompt], max_new,
-                            eos_id=self.tokenizer.eos_id,
-                        ),
+                    token_ids, finish, stats = await self.spec_batcher.submit(
+                        prompt, max_new
                     )
-                    token_ids, finish = outs[0], reasons[0]
                     span.set(**stats)
                 except Exception:
                     logger.exception("speculative generation failed")
@@ -389,6 +412,8 @@ class Sidecar:
                     None, self.generation.warmup_speculative
                 )
             self.batcher.start()
+        if self.spec_batcher is not None:
+            self.spec_batcher.start()
         await self.server.start()
         logger.info(
             "sidecar serving %s (%s) on :%d",
@@ -397,6 +422,8 @@ class Sidecar:
         return self.port
 
     async def stop(self) -> None:
+        if self.spec_batcher is not None:
+            await self.spec_batcher.stop()
         if self.batcher is not None:
             await self.batcher.stop()
         if self.server is not None:

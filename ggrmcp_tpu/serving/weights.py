@@ -1,0 +1,164 @@
+"""HuggingFace → TPU-native parameter conversion.
+
+Loads a HF Llama-format checkpoint directory (`config.json` +
+`*.safetensors`, optionally sharded via `model.safetensors.index.json`)
+into this framework's stacked-layer param pytree, deriving the
+LlamaConfig from the checkpoint's own config. This is the "serve a real
+upstream" posture of the reference (`cmd/grmcp/main.go:156-169` loads a
+real gRPC upstream; here the upstream IS the model).
+
+Conversion notes:
+- torch Linear stores [out, in]; our matmuls are x @ W with W [in, out]
+  → every projection transposes.
+- Per-layer tensors are stacked along a leading L axis (the lax.scan
+  layout, models/llama.py).
+- Our RoPE is the HF rotate-half convention (first-half/second-half
+  split, ops/rope.py), so Q/K rows need NO permutation.
+- Tensors stream one at a time through torch (bf16-safe) and are cast
+  to the model dtype on the host, so peak host memory stays ~one layer
+  above the checkpoint size.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Callable
+
+import numpy as np
+
+from ggrmcp_tpu.models.llama import LlamaConfig
+
+logger = logging.getLogger("ggrmcp.serving.weights")
+
+
+def read_hf_config(path: str) -> LlamaConfig:
+    """Derive a LlamaConfig from a HF `config.json` directory."""
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    if "Llama" not in arch and "Mistral" not in arch:
+        raise ValueError(f"unsupported HF architecture: {arch}")
+    num_heads = hf["num_attention_heads"]
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
+    return LlamaConfig(
+        name=hf.get("_name_or_path") or os.path.basename(path.rstrip("/"))
+        or "hf-llama",
+        vocab_size=hf["vocab_size"],
+        hidden_dim=hf["hidden_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=num_heads,
+        num_kv_heads=hf.get("num_key_value_heads", num_heads),
+        head_dim=head_dim,
+        ffn_dim=hf["intermediate_size"],
+        max_seq_len=hf.get("max_position_embeddings", 4096),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        dtype="bfloat16",
+    )
+
+
+def _tensor_reader(
+    path: str,
+) -> tuple[Callable[[str], np.ndarray], set[str], Callable[[], None]]:
+    """Return (read(name) -> float32 ndarray, available names, close())
+    over the checkpoint's safetensors file(s). Handles the sharded-index
+    layout. Goes through torch because numpy has no bfloat16. Callers
+    must invoke close() when done — the handles mmap the checkpoint and
+    would otherwise pin it for the process lifetime."""
+    from safetensors import safe_open
+
+    index_path = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            weight_map: dict[str, str] = json.load(f)["weight_map"]
+    else:
+        files = sorted(
+            f for f in os.listdir(path) if f.endswith(".safetensors")
+        )
+        if not files:
+            raise FileNotFoundError(f"no .safetensors files under {path}")
+        weight_map = {}
+        for fname in files:
+            with safe_open(os.path.join(path, fname), framework="pt") as f:
+                for name in f.keys():
+                    weight_map[name] = fname
+
+    handles: dict[str, Any] = {}
+
+    def read(name: str) -> np.ndarray:
+        fname = weight_map[name]
+        if fname not in handles:
+            handles[fname] = safe_open(
+                os.path.join(path, fname), framework="pt"
+            )
+        t = handles[fname].get_tensor(name)
+        return t.to(dtype=__import__("torch").float32).numpy()
+
+    def close() -> None:
+        for h in handles.values():
+            h.__exit__(None, None, None)
+        handles.clear()
+
+    return read, set(weight_map), close
+
+
+def load_hf_checkpoint(path: str) -> tuple[LlamaConfig, dict]:
+    """Load a HF Llama checkpoint directory → (LlamaConfig, params).
+
+    The returned pytree matches `llama.init_params` exactly (verified by
+    tests/test_weights.py's logit-parity test against `transformers`)."""
+    cfg = read_hf_config(path)
+    read, names, close = _tensor_reader(path)
+    dtype = cfg.jnp_dtype
+    l = cfg.num_layers
+
+    def t(name: str) -> np.ndarray:  # torch Linear [out, in] → [in, out]
+        return read(name).T
+
+    def stack(fmt: str, conv: Callable[[str], np.ndarray]) -> np.ndarray:
+        return np.stack(
+            [conv(fmt.format(i)).astype(dtype) for i in range(l)]
+        )
+
+    def qkv(i: int) -> np.ndarray:
+        pre = f"model.layers.{i}.self_attn"
+        return np.concatenate(
+            [
+                t(f"{pre}.q_proj.weight"),
+                t(f"{pre}.k_proj.weight"),
+                t(f"{pre}.v_proj.weight"),
+            ],
+            axis=1,
+        )  # [D, (H + 2*KVH) * Dh]
+
+    try:
+        params = {
+            "embed": read("model.embed_tokens.weight").astype(dtype),
+            "layers": {
+                "attn_norm": stack(
+                    "model.layers.{}.input_layernorm.weight", read
+                ),
+                "wqkv": np.stack([qkv(i).astype(dtype) for i in range(l)]),
+                "wo": stack("model.layers.{}.self_attn.o_proj.weight", t),
+                "mlp_norm": stack(
+                    "model.layers.{}.post_attention_layernorm.weight", read
+                ),
+                "w_gate": stack("model.layers.{}.mlp.gate_proj.weight", t),
+                "w_up": stack("model.layers.{}.mlp.up_proj.weight", t),
+                "w_down": stack("model.layers.{}.mlp.down_proj.weight", t),
+            },
+            "final_norm": read("model.norm.weight").astype(dtype),
+        }
+        if "lm_head.weight" in names:
+            params["lm_head"] = t("lm_head.weight").astype(dtype)
+        else:  # tied embeddings
+            params["lm_head"] = params["embed"].T.copy()
+    finally:
+        close()
+    logger.info(
+        "loaded HF checkpoint %s: %s (%d layers, %d heads/%d kv, d=%d)",
+        path, cfg.name, l, cfg.num_heads, cfg.num_kv_heads, cfg.hidden_dim,
+    )
+    return cfg, params

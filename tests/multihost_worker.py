@@ -1,0 +1,71 @@
+"""Worker process for the two-process multi-host smoke test.
+
+Driven by tests/test_multihost.py: joins the JAX multi-controller
+runtime through parallel/distributed.py's env-based entry (the code
+path a real multi-host deployment uses), builds the global mesh, and
+runs a cross-process sharded computation + a tiny DP train step.
+Prints one `OK ...` line on success; any assertion kills the process
+and fails the parent test.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> None:
+    import jax
+
+    from ggrmcp_tpu.parallel import distributed
+
+    # GGRMCP_COORDINATOR / GGRMCP_NUM_PROCESSES / GGRMCP_PROCESS_ID come
+    # from the parent test's env — the same contract every host of a
+    # real deployment uses.
+    assert distributed.initialize(), "expected multi-process runtime"
+    n_procs = jax.process_count()
+    assert n_procs == 2, n_procs
+    local = jax.local_device_count()
+    total = jax.device_count()
+    assert total == 2 * local, (total, local)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ggrmcp_tpu.core.config import MeshConfig
+    from ggrmcp_tpu.models import llama, training
+
+    mesh = distributed.global_mesh(MeshConfig(data=0))
+
+    # Cross-process reduction over the data axis (rides DCN-equivalent
+    # gloo collectives here; ICI+DCN on real pods).
+    x = jnp.arange(float(total))
+    xs = jax.device_put(x, NamedSharding(mesh, P(("data",))))
+    got = float(
+        jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, P()))(xs)
+    )
+    want = total * (total - 1) / 2
+    assert got == want, (got, want)
+
+    # A real DP train step over the global mesh: every process runs the
+    # same program; XLA shards the batch across ALL processes' devices.
+    cfg = llama.CONFIGS["tiny-llama"]
+    state = training.init_train_state(jax.random.PRNGKey(0), cfg)
+    step_fn, _ = training.make_sharded_train_step(cfg, mesh)
+    batch = jnp.asarray(np.ones((total, 16), np.int32))
+    with mesh:
+        state, loss = step_fn(state, batch)
+        loss.block_until_ready()
+    assert np.isfinite(float(loss)), float(loss)
+
+    print(
+        f"OK process={jax.process_index()}/{n_procs} devices={total} "
+        f"sum={got} loss={float(loss):.3f}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

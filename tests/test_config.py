@@ -46,6 +46,17 @@ def test_validate_descriptor_needs_path():
         cfg.validate()
 
 
+def test_validate_tick_steps_vs_cache():
+    # steps_per_tick >= kv_cache_max_seq would make the batcher's fit
+    # limit nonpositive and allow overshoot writes at the cache tail.
+    cfg = cfgmod.default()
+    cfg.serving.batching.decode_steps_per_tick = (
+        cfg.serving.batching.kv_cache_max_seq
+    )
+    with pytest.raises(ValueError, match="decode_steps_per_tick"):
+        cfg.validate()
+
+
 def test_load_json_file(tmp_path):
     p = tmp_path / "cfg.json"
     p.write_text(json.dumps({"server": {"port": 8080}, "grpc": {"host": "tpu-vm"}}))

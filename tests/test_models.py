@@ -74,6 +74,53 @@ class TestOps:
                               interpret=True)
         np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
 
+    def test_flash_gqa_native(self):
+        # K/V carry fewer heads; the kernel maps query head → shared KV
+        # head, matching XLA-with-repeat numerics.
+        key = jax.random.PRNGKey(5)
+        q = jax.random.normal(key, (2, 128, 8, 32))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (2, 128, 2, 32))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (2, 128, 2, 32))
+        ref = attention_xla(
+            q, jnp.repeat(k, 4, axis=2), jnp.repeat(v, 4, axis=2), causal=True
+        )
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                              interpret=True)
+        np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+    def test_flash_cached_prefill_semantics(self):
+        # The serving prefill shape: q is a fresh prompt written into a
+        # longer cache; per-batch q_offset and kv_len drive the mask.
+        key = jax.random.PRNGKey(6)
+        b, sq, sk, h, d = 2, 128, 256, 2, 32
+        q = jax.random.normal(key, (b, sq, h, d))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (b, sk, h, d))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (b, sk, h, d))
+        q_offset = jnp.array([0, 64], jnp.int32)
+        kv_len = jnp.array([128, 192], jnp.int32)
+        ref = attention_xla(
+            q, k, v, causal=True, q_offset=q_offset, kv_len=kv_len
+        )
+        out = flash_attention(
+            q, k, v, causal=True, q_offset=q_offset, kv_len=kv_len,
+            block_q=64, block_k=64, interpret=True,
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+    def test_attention_dispatcher_gqa(self):
+        # The dispatcher accepts narrow K/V and repeats for the XLA path.
+        from ggrmcp_tpu.ops.attention import attention
+
+        key = jax.random.PRNGKey(7)
+        q = jax.random.normal(key, (1, 16, 4, 16))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (1, 16, 2, 16))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (1, 16, 2, 16))
+        out = attention(q, k, v, causal=True, use_flash=False)
+        ref = attention_xla(
+            q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2), causal=True
+        )
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
     def test_greedy_sampling(self):
         logits = jnp.array([[0.0, 5.0, 1.0], [2.0, 0.0, -1.0]])
         out = sample(logits, jax.random.PRNGKey(0), SamplingConfig())

@@ -1,0 +1,58 @@
+"""Two-process multi-host smoke (VERDICT r1 #7): drives
+parallel/distributed.py's env-based initialize over a real
+jax.distributed coordinator with cross-process collectives and a DP
+train step spanning both processes' devices.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_runtime():
+    # No pytest-timeout in the image; the communicate(timeout=240)
+    # below bounds the test on its own.
+    port = _free_port()
+    env_base = {
+        **os.environ,
+        "GGRMCP_COORDINATOR": f"127.0.0.1:{port}",
+        "GGRMCP_NUM_PROCESSES": "2",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        # script-mode sys.path[0] is tests/, not the repo root
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER],
+            env={**env_base, "GGRMCP_PROCESS_ID": str(pid)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=REPO,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out.decode(errors="replace"))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"multihost workers timed out; partial output: {outs}")
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out[-2000:]}"
+        assert "OK process=" in out, f"process {pid} no OK line:\n{out[-2000:]}"

@@ -61,6 +61,104 @@ class TestRingAttention:
             ring_attention(q, k, v, seq_mesh)
 
 
+class TestSequenceParallelServing:
+    """VERDICT r1 #6: long prompts must be able to prefill through the
+    sequence-parallel path FROM THE SERVING ENGINE, with identical
+    numerics/tokens to the local XLA path."""
+
+    def test_forward_attn_impl_matches_local(self, seq_mesh):
+        from functools import partial
+
+        from ggrmcp_tpu.models import llama
+
+        cfg = llama.CONFIGS["tiny-llama"]
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab_size
+        ).astype(jnp.int32)
+        cache_a = llama.KVCache.create(cfg, 2, 64)
+        cache_b = llama.KVCache.create(cfg, 2, 64)
+        ref_logits, ref_cache = llama.forward(params, cfg, tokens, cache_a)
+        sp_logits, sp_cache = jax.jit(
+            partial(
+                llama.forward, cfg=cfg,
+                attn_impl=lambda q, k, v, causal=True: ring_attention(
+                    q, k, v, seq_mesh, causal=causal
+                ),
+            )
+        )(params, tokens=tokens, cache=cache_b)
+        np.testing.assert_allclose(
+            np.asarray(sp_logits), np.asarray(ref_logits),
+            atol=2e-3, rtol=2e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(sp_cache.k), np.asarray(ref_cache.k), atol=2e-4,
+            rtol=2e-4,
+        )
+
+    def test_engine_generates_identically_on_sp_mesh(self, seq_mesh):
+        """Greedy generation through the engine with SP prefill engaged
+        (threshold below the prompt bucket) equals the non-SP engine."""
+        from ggrmcp_tpu.core.config import ServingConfig
+        from ggrmcp_tpu.models import llama
+        from ggrmcp_tpu.serving.engine import GenerationEngine
+
+        cfg = llama.CONFIGS["tiny-llama"]
+        prompt = list(range(3, 40))  # buckets to 64, divisible by seq=4
+        sp_engine = GenerationEngine(
+            cfg,
+            ServingConfig(
+                model="tiny-llama",
+                mesh=MeshConfig(sequence=4, data=0, tensor=1),
+                sp_prefill="ring", sp_prefill_min_seq=64,
+            ),
+            mesh=seq_mesh,
+        )
+        assert sp_engine.sp_prefill == "ring"
+        ref_engine = GenerationEngine(
+            cfg,
+            ServingConfig(model="tiny-llama", sp_prefill=""),
+            mesh=mesh_mod.build_mesh(MeshConfig(sequence=1, tensor=0)),
+        )
+        sp_out, _ = sp_engine.generate([prompt], max_new_tokens=8, seed=0)
+        ref_out, _ = ref_engine.generate([prompt], max_new_tokens=8, seed=0)
+        assert sp_out == ref_out
+
+    async def test_batcher_sp_admission(self, seq_mesh):
+        """Continuous-batcher admission prefill routes long prompts
+        through the SP path (engine.prefill_forward gate)."""
+        from ggrmcp_tpu.core.config import BatchingConfig, ServingConfig
+        from ggrmcp_tpu.models import llama
+        from ggrmcp_tpu.ops.sampling import SamplingConfig
+        from ggrmcp_tpu.serving.batching import ContinuousBatcher
+        from ggrmcp_tpu.serving.engine import GenerationEngine
+
+        cfg = llama.CONFIGS["tiny-llama"]
+        engine = GenerationEngine(
+            cfg,
+            ServingConfig(
+                model="tiny-llama",
+                mesh=MeshConfig(sequence=4, data=0, tensor=1),
+                sp_prefill="ring", sp_prefill_min_seq=64,
+            ),
+            mesh=seq_mesh,
+        )
+        batcher = ContinuousBatcher(engine, BatchingConfig(max_batch_size=4))
+        batcher.start()
+        try:
+            ids: list[int] = []
+            reason = None
+            async for chunk, r in batcher.submit(
+                list(range(3, 40)), 6, SamplingConfig(), seed=0
+            ):
+                ids.extend(chunk)
+                reason = r
+            assert reason in ("stop", "length")
+            assert 0 < len(ids) <= 6
+        finally:
+            await batcher.stop()
+
+
 class TestUlysses:
     def test_causal_matches_reference(self, seq_mesh):
         q, k, v = _qkv()

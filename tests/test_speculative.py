@@ -114,6 +114,65 @@ class TestSidecarIntegration:
             await side.stop()
 
 
+class TestMicroBatching:
+    async def test_concurrent_requests_coalesce(self):
+        """Concurrent greedy requests with a draft configured go out as
+        FEWER device calls than requests (VERDICT r1 #5) and each
+        request's output is identical to a solo run."""
+        import asyncio
+
+        from ggrmcp_tpu.serving.spec_batcher import SpeculativeBatcher
+
+        engine = GenerationEngine(llama.CONFIGS["tiny-llama"], spec_cfg())
+        # Warm the multi-row program so the measured window isn't a
+        # compile stall admitting requests one by one.
+        engine.generate_speculative(PROMPTS, max_new_tokens=8)
+        solo = {
+            i: engine.generate_speculative([p], max_new_tokens=8)[0][0]
+            for i, p in enumerate(PROMPTS)
+        }
+
+        batcher = SpeculativeBatcher(engine)
+        batcher.start()
+        try:
+            results = await asyncio.gather(
+                *(batcher.submit(p, 8) for p in PROMPTS)
+            )
+        finally:
+            await batcher.stop()
+        for i, (ids, reason, _stats) in enumerate(results):
+            assert ids == solo[i]
+            assert reason in ("stop", "length")
+        assert batcher.requests == len(PROMPTS)
+        assert batcher.calls < len(PROMPTS)
+
+    async def test_mixed_caps_truncate_losslessly(self):
+        """A short-cap request batched with a longer one gets exactly
+        its solo output (deterministic greedy prefix)."""
+        import asyncio
+
+        from ggrmcp_tpu.serving.spec_batcher import SpeculativeBatcher
+
+        engine = GenerationEngine(llama.CONFIGS["tiny-llama"], spec_cfg())
+        engine.generate_speculative(PROMPTS[:2], max_new_tokens=12)
+        solo_short = engine.generate_speculative(
+            [PROMPTS[0]], max_new_tokens=3
+        )[0][0]
+
+        batcher = SpeculativeBatcher(engine)
+        batcher.start()
+        try:
+            short, long_ = await asyncio.gather(
+                batcher.submit(PROMPTS[0], 3),
+                batcher.submit(PROMPTS[1], 12),
+            )
+        finally:
+            await batcher.stop()
+        assert short[0] == solo_short
+        assert len(short[0]) <= 3
+        assert len(long_[0]) <= 12
+
+
 class TestValidation:
     def test_embedding_draft_rejected(self):
         with pytest.raises(ValueError, match="decoder"):

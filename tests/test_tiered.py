@@ -1,0 +1,129 @@
+"""Length-tiered KV cache (VERDICT r1 #9): mixed-length admission
+without worst-case allocation, correct routing, and end-to-end serving
+through the sidecar."""
+
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.core.config import BatchingConfig, MeshConfig, ServingConfig
+from ggrmcp_tpu.models import llama
+from ggrmcp_tpu.ops.sampling import SamplingConfig
+from ggrmcp_tpu.serving.engine import GenerationEngine
+from ggrmcp_tpu.serving.tiered import TieredBatcher
+
+TIERS = [[64, 3], [256, 1]]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return GenerationEngine(
+        llama.CONFIGS["tiny-llama"],
+        ServingConfig(model="tiny-llama", mesh=MeshConfig(tensor=2, data=0)),
+    )
+
+
+def test_config_validation():
+    from ggrmcp_tpu.core import config as cfgmod
+
+    cfg = cfgmod.default()
+    cfg.serving.batching.kv_tiers = [[512, 8], [256, 4]]  # not ascending
+    with pytest.raises(ValueError, match="ascending"):
+        cfg.validate()
+    cfg.serving.batching.kv_tiers = [[512, 8], [4096, 2]]
+    cfg.validate()
+
+
+def test_hbm_headroom_vs_flat_pool(engine):
+    """The point of tiering: same worst-case request capacity, less KV
+    memory than a flat pool of equal slot count × global max."""
+    tiered = TieredBatcher(
+        engine, BatchingConfig(kv_tiers=TIERS, max_queue_delay_ms=1.0)
+    )
+    slots = sum(s for _, s in TIERS)
+    flat_bytes = 2 * (  # k + v
+        engine.cfg.num_layers * slots * 256  # global max seq
+        * engine.cfg.num_kv_heads * engine.cfg.head_dim
+        * np.dtype(engine.cfg.jnp_dtype).itemsize
+    )
+    assert tiered.cache_bytes() < flat_bytes / 2
+
+
+def test_routing_picks_smallest_fitting_tier(engine):
+    tiered = TieredBatcher(
+        engine, BatchingConfig(kv_tiers=TIERS, max_queue_delay_ms=1.0)
+    )
+    short, long_ = tiered.tiers
+    assert tiered._route(10, 16) is short
+    assert tiered._route(100, 16) is long_
+    assert tiered._route(40, 30) is long_  # 40+30+1 > 64
+    # Oversized → largest tier (its fit_request clamps).
+    assert tiered._route(1000, 64) is long_
+
+
+async def test_mixed_lengths_generate(engine):
+    import asyncio
+
+    tiered = TieredBatcher(
+        engine, BatchingConfig(kv_tiers=TIERS, max_queue_delay_ms=2.0)
+    )
+    tiered.start()
+
+    async def run(prompt_len: int, max_new: int, seed: int):
+        ids: list[int] = []
+        reason = None
+        async for chunk, r in tiered.submit(
+            [3 + seed % 40] * prompt_len, max_new,
+            SamplingConfig(temperature=0.8), seed=seed,
+        ):
+            ids.extend(chunk)
+            reason = r
+        assert reason in ("stop", "length")
+        assert len(ids) <= max_new
+        return ids
+
+    try:
+        # 6 concurrent requests across both tiers (3 short slots force
+        # queueing too).
+        outs = await asyncio.wait_for(
+            asyncio.gather(
+                run(5, 6, 1), run(8, 4, 2), run(12, 6, 3),
+                run(100, 6, 4), run(5, 5, 5), run(90, 4, 6),
+            ),
+            timeout=120,
+        )
+        assert all(len(o) > 0 for o in outs)
+    finally:
+        await tiered.stop()
+
+
+async def test_sidecar_with_tiers():
+    import grpc
+    import grpc.aio
+
+    from ggrmcp_tpu.rpc.pb import serving_pb2
+    from ggrmcp_tpu.serving.sidecar import Sidecar
+
+    side = Sidecar(
+        ServingConfig(
+            model="tiny-llama",
+            mesh=MeshConfig(tensor=2, data=0),
+            batching=BatchingConfig(kv_tiers=TIERS, max_queue_delay_ms=2.0),
+        )
+    )
+    port = await side.start(0)
+    channel = grpc.aio.insecure_channel(f"localhost:{port}")
+    try:
+        gen = channel.unary_unary(
+            "/ggrmcp.tpu.GenerateService/Generate",
+            request_serializer=serving_pb2.GenerateRequest.SerializeToString,
+            response_deserializer=serving_pb2.GenerateResponse.FromString,
+        )
+        resp = await gen(
+            serving_pb2.GenerateRequest(
+                prompt="tiered", max_new_tokens=5, return_tokens=True
+            )
+        )
+        assert 0 < resp.completion_tokens <= 5
+    finally:
+        await channel.close()
+        await side.stop()
