@@ -47,6 +47,10 @@ def build_parser() -> argparse.ArgumentParser:
     gw.add_argument(
         "--quantize", default=None, help="sidecar weight quantization (int8)"
     )
+    gw.add_argument(
+        "--workers", type=int, default=None,
+        help="gateway worker processes sharing the port (SO_REUSEPORT)",
+    )
 
     tr = sub.add_parser("train", help="fine-tune a model (checkpoint/resume)")
     tr.add_argument("--model", default=None, help="model registry key")
@@ -102,6 +106,8 @@ def load_config(args: argparse.Namespace) -> cfgmod.Config:
         cfg.serving.quantize = args.quantize
     if getattr(args, "port", None):
         cfg.serving.port = args.port
+    if getattr(args, "workers", None):
+        cfg.server.workers = args.workers
     cfg.validate()
     return cfg
 
@@ -142,6 +148,17 @@ def main(argv: list[str] | None = None) -> int:
             args = build_parser().parse_args(["gateway"] + (argv or sys.argv[1:]))
         cfg = load_config(args)
         targets = args.backend if args.backend else [cfg.grpc.target]
+        if cfg.server.workers > 1:
+            if args.tpu:
+                raise SystemExit(
+                    "--workers > 1 is incompatible with --tpu (each worker "
+                    "would co-launch its own sidecar); run the sidecar "
+                    "separately and point --backend at it"
+                )
+            from ggrmcp_tpu.gateway.app import run_multiworker
+
+            run_multiworker(cfg, targets)
+            return 0
         if args.tpu:
             from ggrmcp_tpu.serving.launcher import run_gateway_with_sidecar
 

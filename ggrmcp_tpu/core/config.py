@@ -63,6 +63,13 @@ class ServerConfig:
     request_timeout_s: float = 30.0
     max_request_bytes: int = 1 << 20  # 1 MB
     shutdown_grace_s: float = 30.0
+    # Worker processes sharing the listen port via SO_REUSEPORT. The Go
+    # reference used every core through goroutines; asyncio is
+    # single-core, so >1 scales the gateway across cores. Sessions are
+    # worker-local (kernel hashing keeps a keep-alive connection on one
+    # worker; use 1 worker or a sticky LB if cross-connection session
+    # continuity matters). Requires a fixed port.
+    workers: int = 1
     allowed_content_types: list[str] = field(
         default_factory=lambda: ["application/json"]
     )
@@ -380,6 +387,8 @@ class Config:
         """Raise ValueError on nonsense values (config.go:328-357 parity)."""
         if not (0 < self.server.port < 65536):
             raise ValueError(f"invalid HTTP port: {self.server.port}")
+        if self.server.workers < 1:
+            raise ValueError("server.workers must be >= 1")
         if not (0 < self.grpc.port < 65536):
             raise ValueError(f"invalid gRPC port: {self.grpc.port}")
         if self.server.request_timeout_s <= 0:

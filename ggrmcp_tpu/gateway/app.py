@@ -67,7 +67,9 @@ class Gateway:
         app.router.add_get("/debug/traces", self.handler.handle_traces)
         return app
 
-    async def start(self, connect_backends: bool = True) -> None:
+    async def start(
+        self, connect_backends: bool = True, reuse_port: bool = False
+    ) -> None:
         if connect_backends and self.discoverer.backends:
             try:
                 await self.discoverer.connect(self.cfg.grpc.connect_timeout_s)
@@ -87,7 +89,8 @@ class Gateway:
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
         self._site = web.TCPSite(
-            self._runner, self.cfg.server.host, self.cfg.server.port
+            self._runner, self.cfg.server.host, self.cfg.server.port,
+            reuse_port=reuse_port or None,
         )
         await self._site.start()
         for s in self._runner.sites:
@@ -110,8 +113,8 @@ class Gateway:
             )
         await self.discoverer.close()
 
-    async def run_forever(self) -> None:
-        await self.start()
+    async def run_forever(self, reuse_port: bool = False) -> None:
+        await self.start(reuse_port=reuse_port)
         stop_event = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
@@ -124,7 +127,73 @@ class Gateway:
         await self.stop()
 
 
-def run(cfg: Config, targets: Optional[list[str]] = None) -> None:
+def run(
+    cfg: Config,
+    targets: Optional[list[str]] = None,
+    reuse_port: bool = False,
+) -> None:
     setup_logging(cfg)
     gateway = Gateway(cfg, targets)
-    asyncio.run(gateway.run_forever())
+    asyncio.run(gateway.run_forever(reuse_port=reuse_port))
+
+
+def _worker_entry(cfg: Config, targets: Optional[list[str]], idx: int) -> None:
+    """Module-level target for multiprocessing spawn (must pickle)."""
+    logging.getLogger("ggrmcp.gateway").info("worker %d starting", idx)
+    run(cfg, targets, reuse_port=True)
+
+
+def run_multiworker(cfg: Config, targets: Optional[list[str]] = None) -> None:
+    """N gateway processes sharing one port via SO_REUSEPORT
+    (server.workers > 1): the kernel hashes connections across workers,
+    scaling the asyncio gateway over cores the way the Go reference's
+    goroutines did. Each worker owns its full stack (discovery,
+    sessions, metrics); sessions are worker-local (ServerConfig.workers
+    doc). The parent only supervises: SIGTERM/SIGINT fan out to
+    workers; any worker death tears the group down (a supervisor/
+    orchestrator restarts the process group)."""
+    import multiprocessing
+    import signal as _signal
+
+    setup_logging(cfg)
+    ctx = multiprocessing.get_context("spawn")
+    workers = [
+        ctx.Process(
+            target=_worker_entry, args=(cfg, targets, i), name=f"gw-worker-{i}"
+        )
+        for i in range(cfg.server.workers)
+    ]
+    for w in workers:
+        w.start()
+    logger.info(
+        "gateway: %d workers on %s:%d (SO_REUSEPORT)",
+        len(workers), cfg.server.host, cfg.server.port,
+    )
+
+    def _forward(signum, frame):  # noqa: ARG001
+        for w in workers:
+            if w.is_alive() and w.pid:
+                import os as _os
+
+                _os.kill(w.pid, _signal.SIGTERM)
+
+    _signal.signal(_signal.SIGTERM, _forward)
+    _signal.signal(_signal.SIGINT, _forward)
+    try:
+        while True:
+            for w in workers:
+                w.join(timeout=0.5)
+                if not w.is_alive():
+                    if w.exitcode not in (0, -_signal.SIGTERM.value):
+                        logger.error(
+                            "worker %s died (exit %s); stopping group",
+                            w.name, w.exitcode,
+                        )
+                    _forward(None, None)
+                    for rest in workers:
+                        rest.join(timeout=cfg.server.shutdown_grace_s)
+                    return
+    finally:
+        for w in workers:
+            if w.is_alive():
+                w.terminate()

@@ -49,6 +49,10 @@ class LlamaConfig(common.ModelConfig):
     ffn_dim: int = 1408
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
+    # Llama-3-style long-context RoPE scaling as a hashable 4-tuple
+    # (factor, low_freq_factor, high_freq_factor,
+    # original_max_position_embeddings); None = unscaled (ops/rope.py).
+    rope_scaling: Optional[tuple] = None
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
 
@@ -198,8 +202,8 @@ def attention_block(
     q = q.reshape(b, s, h, hd)
     k = k.reshape(b, s, kvh, hd)
     v = v.reshape(b, s, kvh, hd)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
     if cache_k is not None:
         # Write new K/V at each sequence's current length, then attend

@@ -8,24 +8,49 @@ is carried; positions are explicit so the same code serves prefill
 
 from __future__ import annotations
 
+import math
+from typing import Optional
+
 import jax.numpy as jnp
 
 
-def rope_freqs(head_dim: int, theta: float = 10000.0) -> jnp.ndarray:
-    """Inverse frequencies for half the head dim: [head_dim // 2]."""
+def rope_freqs(
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling: Optional[tuple] = None,
+) -> jnp.ndarray:
+    """Inverse frequencies for half the head dim: [head_dim // 2].
+
+    `scaling`: optional Llama-3-style long-context frequency scaling as
+    a hashable 4-tuple (factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings) — tuple, not dict, so model
+    configs carrying it stay usable as jit static args.
+    Long-wavelength (low-freq) components are slowed by `factor`, short
+    wavelengths untouched, and a linear ramp blends between the two
+    cutoffs — the published llama3 `rope_type` rule that Llama-3.1+
+    checkpoints require for correct logits.
+    """
     exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
-    return 1.0 / (theta**exponent)
+    freqs = 1.0 / (theta**exponent)
+    if scaling:
+        factor, low, high, orig = (float(v) for v in scaling)
+        wavelen = 2.0 * math.pi / freqs
+        ramp = (orig / wavelen - low) / (high - low)  # <0 long, >1 short
+        smooth = jnp.clip(ramp, 0.0, 1.0)
+        freqs = (1.0 - smooth) * freqs / factor + smooth * freqs
+    return freqs
 
 
 def apply_rope(
     x: jnp.ndarray,  # [..., seq, num_heads, head_dim]
     positions: jnp.ndarray,  # [..., seq]
     theta: float = 10000.0,
+    scaling: Optional[tuple] = None,
 ) -> jnp.ndarray:
     """Rotate pairs (x[..., :d/2], x[..., d/2:]) by position-dependent
     angles. Computed in float32 and cast back (bf16-safe)."""
     head_dim = x.shape[-1]
-    freqs = rope_freqs(head_dim, theta)  # [d/2]
+    freqs = rope_freqs(head_dim, theta, scaling)  # [d/2]
     angles = positions[..., None].astype(jnp.float32) * freqs  # [..., seq, d/2]
     cos = jnp.cos(angles)[..., None, :]  # [..., seq, 1, d/2]
     sin = jnp.sin(angles)[..., None, :]

@@ -61,6 +61,18 @@ class SpeculativeBatcher:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        # Fail anything still queued — submit() callers are awaiting
+        # these futures and would otherwise hang past graceful-shutdown
+        # grace (in-flight batches fail their futures in _run_batch).
+        while not self.queue.empty():
+            try:
+                _, _, fut = self.queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if not fut.done():
+                fut.set_exception(
+                    RuntimeError("speculative batcher stopped")
+                )
 
     async def submit(
         self, prompt: list[int], max_new: int
@@ -91,7 +103,28 @@ class SpeculativeBatcher:
                     break
             await self._run_batch(loop, batch)
 
+    def _fit_limit(self) -> int:
+        return min(
+            self.engine.cfg.max_seq_len, self.engine.draft_cfg.max_seq_len
+        )
+
     async def _run_batch(self, loop, batch) -> None:
+        # Lossless guard: batching raises every row's decode budget to
+        # max(caps), and fit_request trims a prompt to
+        # limit - budget - 1 — a near-limit prompt would lose MORE
+        # context batched than solo, changing its output. Split such
+        # requests into their own single-row calls (own cap → solo
+        # semantics, exactly).
+        limit = self._fit_limit()
+        budget = max(cap for _, cap, _ in batch)
+        safe = [b for b in batch if len(b[0]) + budget + 1 <= limit]
+        unsafe = [b for b in batch if len(b[0]) + budget + 1 > limit]
+        if unsafe and len(batch) > 1:
+            for b in unsafe:
+                await self._run_batch(loop, [b])
+            if not safe:
+                return
+            batch = safe
         prompts = [b[0] for b in batch]
         caps = [b[1] for b in batch]
         futs = [b[2] for b in batch]
@@ -105,11 +138,17 @@ class SpeculativeBatcher:
                     prompts, budget, eos_id=self.eos_id
                 ),
             )
-        except Exception as exc:
+        except BaseException as exc:
             logger.exception("speculative batch of %d failed", len(batch))
+            failure = (
+                RuntimeError("speculative batcher stopped")
+                if isinstance(exc, asyncio.CancelledError) else exc
+            )
             for fut in futs:
                 if not fut.done():
-                    fut.set_exception(exc)
+                    fut.set_exception(failure)
+            if not isinstance(exc, Exception):
+                raise  # propagate cancellation
             return
         # Rounds/drafted/accepted are BATCH aggregates — tag them so a
         # per-request trace span is interpretable.

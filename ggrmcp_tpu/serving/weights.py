@@ -42,6 +42,24 @@ def read_hf_config(path: str) -> LlamaConfig:
         raise ValueError(f"unsupported HF architecture: {arch}")
     num_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
+    rs = hf.get("rope_scaling") or None
+    rope_scaling = None
+    if rs:
+        # Llama-3.1+ ships rope_type "llama3"; serving such a
+        # checkpoint with unscaled frequencies would produce silently
+        # divergent logits, so unknown schemes are a hard error.
+        rope_type = rs.get("rope_type") or rs.get("type")
+        if rope_type != "llama3":
+            raise ValueError(
+                f"unsupported rope_scaling type {rope_type!r} "
+                f"(supported: 'llama3')"
+            )
+        rope_scaling = (
+            float(rs["factor"]),
+            float(rs.get("low_freq_factor", 1.0)),
+            float(rs.get("high_freq_factor", 4.0)),
+            float(rs["original_max_position_embeddings"]),
+        )
     return LlamaConfig(
         name=hf.get("_name_or_path") or os.path.basename(path.rstrip("/"))
         or "hf-llama",
@@ -54,6 +72,7 @@ def read_hf_config(path: str) -> LlamaConfig:
         ffn_dim=hf["intermediate_size"],
         max_seq_len=hf.get("max_position_embeddings", 4096),
         rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rope_scaling=rope_scaling,
         norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         dtype="bfloat16",
     )

@@ -1,0 +1,87 @@
+"""Multi-worker gateway (SO_REUSEPORT): two worker processes share one
+port and both serve MCP traffic."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(port: int, body: bytes) -> dict:
+    import json
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_two_workers_share_port():
+    backend = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "examples", "hello_server.py"),
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, cwd=REPO,
+    )
+    gateway = None
+    try:
+        line = backend.stdout.readline().decode().strip()
+        be_port = int(line.removeprefix("PORT="))
+        gw_port = _free_port()
+        gateway = subprocess.Popen(
+            [sys.executable, "-m", "ggrmcp_tpu", "gateway",
+             "--backend", f"localhost:{be_port}",
+             "--http-port", str(gw_port), "--workers", "2", "--dev"],
+            cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        body = (
+            b'{"jsonrpc":"2.0","method":"tools/call","id":1,"params":'
+            b'{"name":"hello_helloservice_sayhello",'
+            b'"arguments":{"name":"workers"}}}'
+        )
+        deadline = time.monotonic() + 60
+        data = None
+        while time.monotonic() < deadline:
+            try:
+                data = _post(gw_port, body)
+                break
+            except Exception:
+                if gateway.poll() is not None:
+                    raise AssertionError("gateway group died during startup")
+                time.sleep(0.5)
+        assert data is not None, "gateway never became ready"
+        assert "Hello, workers!" in data["result"]["content"][0]["text"]
+
+        # The supervisor really forked two workers.
+        kids = subprocess.run(
+            ["pgrep", "-P", str(gateway.pid)], capture_output=True, text=True
+        ).stdout.split()
+        assert len(kids) >= 2, f"expected 2 workers, saw {kids}"
+
+        # Hammer a few more calls — kernel spreads connections; every
+        # one must succeed regardless of which worker serves it.
+        for i in range(10):
+            out = _post(gw_port, body)
+            assert "result" in out, out
+    finally:
+        if gateway is not None and gateway.poll() is None:
+            gateway.send_signal(signal.SIGTERM)
+            try:
+                gateway.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                gateway.kill()
+        backend.kill()
+        backend.wait()

@@ -173,6 +173,52 @@ class TestMicroBatching:
         assert len(long_[0]) <= 12
 
 
+class TestMicroBatchEdgeCases:
+    async def test_near_limit_prompt_keeps_solo_output(self):
+        """A prompt long enough that a batch-raised budget would trim
+        it harder than solo MUST be split out and match its solo run
+        exactly (review finding: lossless guard)."""
+        import asyncio
+
+        from ggrmcp_tpu.serving.spec_batcher import SpeculativeBatcher
+
+        engine = GenerationEngine(llama.CONFIGS["tiny-llama"], spec_cfg())
+        limit = min(engine.cfg.max_seq_len, engine.draft_cfg.max_seq_len)
+        long_prompt = [(i % 50) + 3 for i in range(limit - 10)]
+        solo = engine.generate_speculative([long_prompt], max_new_tokens=4)[0][0]
+
+        batcher = SpeculativeBatcher(engine)
+        batcher.start()
+        try:
+            long_res, short_res = await asyncio.wait_for(
+                asyncio.gather(
+                    batcher.submit(long_prompt, 4),
+                    batcher.submit([5, 6, 7], 64),  # raises batch budget
+                ),
+                timeout=300,
+            )
+        finally:
+            await batcher.stop()
+        assert long_res[0] == solo
+        assert len(short_res[0]) <= 64
+
+    async def test_stop_fails_queued_requests(self):
+        """stop() must resolve queued futures with an error, not leave
+        submit() callers hanging (review finding)."""
+        import asyncio
+
+        from ggrmcp_tpu.serving.spec_batcher import SpeculativeBatcher
+
+        engine = GenerationEngine(llama.CONFIGS["tiny-llama"], spec_cfg())
+        batcher = SpeculativeBatcher(engine)
+        # NOT started: submissions sit in the queue forever.
+        task = asyncio.create_task(batcher.submit([1, 2, 3], 4))
+        await asyncio.sleep(0.05)
+        await batcher.stop()
+        with pytest.raises(RuntimeError, match="stopped"):
+            await asyncio.wait_for(task, timeout=10)
+
+
 class TestValidation:
     def test_embedding_draft_rejected(self):
         with pytest.raises(ValueError, match="decoder"):

@@ -22,7 +22,7 @@ from ggrmcp_tpu.serving.weights import (  # noqa: E402
 )
 
 
-def _tiny_hf_model(tmp_path, tie_embeddings: bool = False):
+def _tiny_hf_model(tmp_path, tie_embeddings: bool = False, rope_scaling=None):
     cfg = transformers.LlamaConfig(
         vocab_size=128,
         hidden_size=64,
@@ -34,6 +34,7 @@ def _tiny_hf_model(tmp_path, tie_embeddings: bool = False):
         rms_norm_eps=1e-5,
         rope_theta=10000.0,
         tie_word_embeddings=tie_embeddings,
+        rope_scaling=rope_scaling,
     )
     torch.manual_seed(0)
     model = transformers.LlamaForCausalLM(cfg)
@@ -75,6 +76,54 @@ def test_logit_parity_with_transformers(tmp_path):
 
     ours, _ = llama.forward(params, cfg, tokens)
     np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-3, rtol=2e-3)
+
+
+LLAMA3_SCALING = {
+    "rope_type": "llama3",
+    "factor": 8.0,
+    "low_freq_factor": 1.0,
+    "high_freq_factor": 4.0,
+    "original_max_position_embeddings": 64,
+}
+
+
+def test_rope_scaling_logit_parity(tmp_path):
+    """Llama-3.1-style rope_scaling checkpoints must produce the SAME
+    logits as transformers — unscaled frequencies would silently
+    diverge at every position (review finding)."""
+    model, path = _tiny_hf_model(tmp_path, rope_scaling=LLAMA3_SCALING)
+    cfg, params = load_hf_checkpoint(path)
+    assert cfg.rope_scaling == (8.0, 1.0, 4.0, 64.0)
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": "float32"})
+    params = {
+        k: (
+            {kk: np.asarray(vv, np.float32) for kk, vv in v.items()}
+            if isinstance(v, dict)
+            else np.asarray(v, np.float32)
+        )
+        for k, v in params.items()
+    }
+    # Positions past original_max_position_embeddings exercise the
+    # scaled-frequency region.
+    tokens = np.arange(96, dtype=np.int32)[None, :] % 128
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    ours, _ = llama.forward(params, cfg, tokens)
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=3e-3, rtol=3e-3)
+
+
+def test_unknown_rope_scaling_rejected(tmp_path):
+    _, path = _tiny_hf_model(tmp_path)
+    import os
+
+    cfg_path = os.path.join(path, "config.json")
+    with open(cfg_path) as f:
+        hf = json.load(f)
+    hf["rope_scaling"] = {"rope_type": "yarn", "factor": 4.0}
+    with open(cfg_path, "w") as f:
+        json.dump(hf, f)
+    with pytest.raises(ValueError, match="rope_scaling"):
+        load_hf_checkpoint(path)
 
 
 def test_tied_embeddings(tmp_path):
