@@ -20,10 +20,13 @@ new framework; the Go reference has no model execution at all):
 - Embedding, final norm and the LM head run OUTSIDE the pipeline in
   plain auto-sharded (TP/DP) form; only the layer stack is staged.
 
-Scope: full-sequence forward (training / scoring). Autoregressive
-decode keeps to TP/DP meshes where the whole model fits a slice —
-staged decode would pipeline single-token microbatches and is not a
-throughput win until a model exceeds slice HBM.
+Scope: full-sequence forward (training / scoring) AND cached serving
+(`pipeline_forward_cached`): the same tick schedule threads each
+stage's local [L/S, ...] KV-cache block, with microbatches slicing the
+batch dimension — so prefill and batched decode both pipeline across
+stages. This is the serve-a-model-bigger-than-a-slice path; on meshes
+where the model fits, TP/DP remain the better choice (decode latency
+still pays the S-stage traversal).
 """
 
 from __future__ import annotations
@@ -208,6 +211,154 @@ def pipeline_forward_with_aux(
     x = common.rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x @ params["lm_head"].astype(cfg.jnp_dtype)
     return logits.astype(jnp.float32), aux
+
+
+# ---------------------------------------------------------------------------
+# Cached (serving) pipeline: prefill + decode with a staged KV cache
+# ---------------------------------------------------------------------------
+
+
+def cache_specs_pp() -> llama_mod.KVCache:
+    """KV cache sharding for the staged path: layer dim over `stage`
+    (batch over data as usual, heads over tensor)."""
+    spec = P("stage", ("data", "fsdp"), None, "tensor", None)
+    return llama_mod.KVCache(
+        k=spec, v=spec, length=P(("data", "fsdp"))
+    )
+
+
+def _run_block_cached(layers_local, x, cfg, positions, ck, cv, clen, fam):
+    """Scan this stage's local layer block threading its cache block.
+    ck/cv: [L/S, mb, S_max, KVH, D] for the current microbatch's rows."""
+
+    def body(h, scanned):
+        lp, k_layer, v_layer = scanned
+        h, (k2, v2) = fam._layer(
+            h, lp, cfg, positions, k_layer, v_layer, clen, use_flash=False
+        )
+        return h, (k2, v2)
+
+    x, (ck2, cv2) = jax.lax.scan(body, x, (layers_local, ck, cv))
+    return x, ck2, cv2
+
+
+def pipeline_forward_cached(
+    params: common.Params,
+    cfg: llama_mod.LlamaConfig,
+    tokens: jnp.ndarray,  # [B, S]
+    cache: llama_mod.KVCache,  # k/v [L, B, S_max, KVH, D], layer-staged
+    mesh: Mesh,
+    num_microbatches: Optional[int] = None,
+) -> tuple[jnp.ndarray, llama_mod.KVCache]:
+    """`llama.forward(..., cache=...)` semantics with the layer stack
+    (and its KV cache) pipelined over `stage`. Serves both prefill
+    (S > 1) and decode (S == 1); microbatches split the BATCH dim, so
+    batched decode overlaps stages GPipe-style. Dense Llama only.
+
+    Must run under jit (every engine path is): this JAX version rejects
+    partial-manual shard_map out_specs naming the manual axis when
+    applied eagerly."""
+    from ggrmcp_tpu.ops.quant import QuantizedArray, embed_lookup
+    from ggrmcp_tpu.ops.quant import matmul as qmatmul
+
+    S_stages = stage_count(mesh)
+    b, s = tokens.shape
+    x = embed_lookup(params["embed"], tokens, cfg.jnp_dtype)
+    positions = cache.length[:, None] + jnp.arange(s)[None, :]
+    fam = _family(cfg)
+
+    if S_stages == 1:
+        logits, new_cache = fam.forward(params, cfg, tokens, cache)
+        return logits, new_cache
+
+    M = num_microbatches or (S_stages if b % S_stages == 0 else 1)
+    if b % M != 0:
+        raise ValueError(f"batch {b} not divisible by {M} microbatches")
+    if cfg.num_layers % S_stages != 0:
+        raise ValueError(
+            f"{cfg.num_layers} layers not divisible by {S_stages} stages"
+        )
+    mb = b // M
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+    pos_mb = positions.reshape(M, mb, s)
+    clen_mb = cache.length.reshape(M, mb)
+
+    layer_specs = jax.tree_util.tree_map(lambda _: P("stage"), params["layers"])
+    fwd = partial(
+        _pipelined_cached, cfg=cfg, fam=fam, num_stages=S_stages,
+        num_micro=M, mb=mb,
+    )
+    out, new_k, new_v = jax.shard_map(
+        fwd,
+        mesh=mesh,
+        axis_names={"stage"},
+        in_specs=(layer_specs, P(), P(), P(), P("stage"), P("stage")),
+        out_specs=(P(), P("stage"), P("stage")),
+        check_vma=False,
+    )(params["layers"], x_mb, pos_mb, clen_mb, cache.k, cache.v)
+    x = out.reshape(b, s, -1)
+
+    x = common.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["lm_head"]
+    if not isinstance(head, QuantizedArray):
+        head = head.astype(cfg.jnp_dtype)
+    logits = qmatmul(x, head)
+    new_cache = llama_mod.KVCache(
+        k=new_k, v=new_v, length=cache.length + s
+    )
+    return logits.astype(jnp.float32), new_cache
+
+
+def _pipelined_cached(
+    layers_local, x_mb, pos_mb, clen_mb, ck, cv, *, cfg, fam, num_stages,
+    num_micro, mb,
+):
+    """Per-stage body with the stage's local cache block threaded
+    through the tick schedule. ck/cv: [L/S, B, S_max, KVH, D]; the tick
+    for microbatch m slices rows [m*mb, (m+1)*mb) and commits the
+    updated block only when the (stage, tick) pair is live — junk
+    drain/fill ticks never touch the cache."""
+    S, M = num_stages, num_micro
+    stage = jax.lax.axis_index("stage")
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    state0 = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+    out0 = jnp.zeros_like(x_mb)
+
+    def tick(carry, t):
+        state, out, ck, cv = carry
+        m_in = jnp.clip(t, 0, M - 1)
+        inp = jax.lax.dynamic_index_in_dim(x_mb, m_in, 0, keepdims=False)
+        state = jnp.where(stage == 0, inp, state)
+        m = jnp.clip(t - stage, 0, M - 1)
+        pos = jax.lax.dynamic_index_in_dim(pos_mb, m, 0, keepdims=False)
+        clen = jax.lax.dynamic_index_in_dim(clen_mb, m, 0, keepdims=False)
+        row0 = m * mb
+        ck_m = jax.lax.dynamic_slice_in_dim(ck, row0, mb, axis=1)
+        cv_m = jax.lax.dynamic_slice_in_dim(cv, row0, mb, axis=1)
+        y, ck2_m, cv2_m = _run_block_cached(
+            layers_local, state, cfg, pos, ck_m, cv_m, clen, fam
+        )
+        live = (t - stage >= 0) & (t - stage < M)
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            ck, jnp.where(live, ck2_m, ck_m), row0, axis=1
+        )
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            cv, jnp.where(live, cv2_m, cv_m), row0, axis=1
+        )
+        m_out = t - (S - 1)
+        upd = jax.lax.dynamic_update_index_in_dim(
+            out, y, jnp.clip(m_out, 0, M - 1), 0
+        )
+        out = jnp.where((stage == S - 1) & (m_out >= 0), upd, out)
+        state = jax.lax.ppermute(y, "stage", perm)
+        return (state, out, ck, cv), None
+
+    (state, out, ck, cv), _ = jax.lax.scan(
+        tick, (state0, out0, ck, cv), jnp.arange(S + M - 1)
+    )
+    out = jax.lax.psum(jnp.where(stage == S - 1, out, 0), "stage")
+    return out, ck, cv
 
 
 # ---------------------------------------------------------------------------

@@ -185,16 +185,10 @@ class ContinuousBatcher:
 
         def body(carry, i):
             cur, cache = carry
-            if self._is_moe:
-                logits, cache = self.fam.forward(
-                    self.engine.params, self.engine.cfg, cur[:, None], cache,
-                    valid=active[:, None], use_flash=self.engine.use_flash,
-                )
-            else:
-                logits, cache = self.fam.forward(
-                    self.engine.params, self.engine.cfg, cur[:, None], cache,
-                    use_flash=self.engine.use_flash,
-                )
+            logits, cache = self.engine.decode_forward(
+                self.engine.params, cur[:, None], cache,
+                valid=active[:, None] if self._is_moe else None,
+            )
             nxt = sample_dynamic(logits[:, -1], seeds, step + i, temps, ks, ps)
             return (nxt, cache), nxt
 
@@ -209,15 +203,12 @@ class ContinuousBatcher:
         if self._is_moe:
             offset = mini.length[:, None]
             valid = (offset + jnp.arange(tokens.shape[1])[None, :]) < true_len
-            logits, mini = self.fam.forward(
-                params, self.engine.cfg, tokens, mini, valid=valid,
-                use_flash=self.engine.use_flash,
-            )
         else:
-            logits, mini = self.fam.forward(
-                params, self.engine.cfg, tokens, mini,
-                use_flash=self.engine.use_flash,
-            )
+            valid = None
+        # Cache-extending step (not a fresh prefill) → decode_forward.
+        logits, mini = self.engine.decode_forward(
+            params, tokens, mini, valid=valid
+        )
         return logits, mini
 
     def _insert_row_impl(self, cache, mini, slot, length):

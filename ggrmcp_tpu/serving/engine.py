@@ -121,11 +121,17 @@ class GenerationEngine:
         # multi-device forces the XLA path (ops/attention.py).
         self.use_flash = None if self.mesh.devices.size == 1 else False
         self._init_sp_prefill()
+        self._init_pp_serving()
+        param_specs = (
+            self._pp.param_specs_pp(cfg) if self.pp_serving
+            else self.fam.param_specs(cfg)
+        )
+        self._param_specs = param_specs
         if params is None:
             t0 = time.monotonic()
             params = _sharded_init(
                 partial(self.fam.init_params, cfg=cfg),
-                self.fam.param_specs(cfg), self.mesh,
+                param_specs, self.mesh,
                 jax.random.PRNGKey(seed),
             )
             logger.info(
@@ -133,7 +139,7 @@ class GenerationEngine:
                 cfg.name, count_params(params) / 1e6, time.monotonic() - t0,
             )
         else:
-            params = _shard_params(params, self.fam.param_specs(cfg), self.mesh)
+            params = _shard_params(params, param_specs, self.mesh)
         if self.serving.quantize:
             params = self._quantize_params(params)
         self.params = params
@@ -188,6 +194,10 @@ class GenerationEngine:
         Dispatches to the sequence-parallel path when configured and
         the chunk is long enough; callers (engine + batcher admission)
         use this instead of fam.forward for first-prefill."""
+        if self.pp_serving:
+            return self._pp.pipeline_forward_cached(
+                params, self.cfg, tokens, cache, self.mesh
+            )
         s = tokens.shape[1]
         sp = (
             self._sp_attn is not None
@@ -198,6 +208,43 @@ class GenerationEngine:
         if sp:
             return llama_mod.forward(
                 params, self.cfg, tokens, cache, attn_impl=self._sp_attn
+            )
+        return self.decode_forward(params, tokens, cache, valid=valid)
+
+    def _init_pp_serving(self) -> None:
+        """Serving under pipeline parallelism: when the mesh has a
+        `stage` axis > 1, prefill AND decode run the staged cached
+        forward (parallel/pipeline.py::pipeline_forward_cached) with
+        the layer stack and KV cache sharded over `stage` — the
+        serve-a-model-bigger-than-a-slice path. Dense Llama only."""
+        from ggrmcp_tpu.parallel import pipeline as pp_mod
+
+        self._pp = pp_mod
+        self._pp_n = mesh_mod.axis_size(self.mesh, "stage")
+        self.pp_serving = self._pp_n > 1 and self.fam is llama_mod
+        if self._pp_n > 1 and self.fam is not llama_mod:
+            raise ValueError(
+                "pipeline-parallel serving supports dense Llama only "
+                "(MoE expert dispatch is batch-global per layer block)"
+            )
+        if self.pp_serving and self.cfg.num_layers % self._pp_n != 0:
+            raise ValueError(
+                f"{self.cfg.num_layers} layers not divisible by "
+                f"stage={self._pp_n}"
+            )
+        if self.pp_serving and self.sp_prefill:
+            # One manual-collective scheme at a time: the staged
+            # forward owns the layer loop.
+            logger.warning("sp_prefill disabled under pipeline serving")
+            self.sp_prefill = ""
+            self._sp_attn = None
+
+    def decode_forward(self, params, tokens, cache, valid=None):
+        """fam.forward for decode/extension steps (cache already has
+        history). Dispatches to the staged path under PP."""
+        if self.pp_serving:
+            return self._pp.pipeline_forward_cached(
+                params, self.cfg, tokens, cache, self.mesh
             )
         if self.fam is moe_mod:
             return self.fam.forward(
@@ -217,6 +264,12 @@ class GenerationEngine:
             return
         from ggrmcp_tpu import models as models_mod
 
+        if self.pp_serving:
+            raise ValueError(
+                "speculative decoding is not supported under "
+                "pipeline-parallel serving (the draft/verify loop would "
+                "run the layer scan against stage-sharded weights)"
+            )
         if self.fam is moe_mod:
             raise ValueError(
                 "speculative decoding supports dense decoder targets "
@@ -290,7 +343,11 @@ class GenerationEngine:
             raise ValueError(
                 f"unknown quantize mode {self.serving.quantize!r}"
             )
-        qspecs = quant.quantize_specs(self.fam.param_specs(self.cfg))
+        # The engine's ACTUAL placement specs (stage-sharded under PP):
+        # quantizing with the non-staged specs would reshard every
+        # layer off the stage axis — per-slice HBM ≈ full model, on
+        # exactly the bigger-than-slice targets PP serves.
+        qspecs = quant.quantize_specs(self._param_specs)
         shapes = jax.eval_shape(quant.quantize_model, params)
         qspecs = _adapt_specs(qspecs, shapes, self.mesh)
         before = quant.quantized_nbytes(params)
@@ -333,9 +390,7 @@ class GenerationEngine:
 
     def _decode_impl(self, tokens, cache, rng, step, sampling: SamplingConfig):
         """tokens [B,1] → (next [B], cache)."""
-        logits, cache = self.fam.forward(
-            self.params, self.cfg, tokens, cache, use_flash=self.use_flash
-        )
+        logits, cache = self.decode_forward(self.params, tokens, cache)
         key = jax.random.fold_in(rng, step)
         next_tok = sample(logits[:, -1], key, sampling)
         return next_tok, cache
@@ -356,9 +411,8 @@ class GenerationEngine:
 
         def step(carry, i):
             cur, cache, done = carry
-            logits, cache = self.fam.forward(
-                self.params, self.cfg, cur[:, None], cache,
-                use_flash=self.use_flash,
+            logits, cache = self.decode_forward(
+                self.params, cur[:, None], cache
             )
             key = jax.random.fold_in(rng, i + 1)
             nxt = sample(logits[:, -1], key, sampling)
@@ -384,7 +438,10 @@ class GenerationEngine:
             self.cfg.num_layers, batch, max_len,
             self.cfg.num_kv_heads, self.cfg.head_dim,
         )
-        specs = self.fam.cache_specs()
+        specs = (
+            self._pp.cache_specs_pp() if self.pp_serving
+            else self.fam.cache_specs()
+        )
         specs = llama_mod.KVCache(
             k=mesh_mod.compatible_spec(specs.k, kv_shape, self.mesh),
             v=mesh_mod.compatible_spec(specs.v, kv_shape, self.mesh),

@@ -90,18 +90,30 @@ class SpeculativeBatcher:
         while not self._stopping:
             first = await self.queue.get()
             batch = [first]
-            deadline = time.monotonic() + window_s
-            while len(batch) < max_batch:
-                timeout = deadline - time.monotonic()
-                if timeout <= 0:
-                    break
-                try:
-                    batch.append(
-                        await asyncio.wait_for(self.queue.get(), timeout)
-                    )
-                except asyncio.TimeoutError:
-                    break
-            await self._run_batch(loop, batch)
+            try:
+                deadline = time.monotonic() + window_s
+                while len(batch) < max_batch:
+                    timeout = deadline - time.monotonic()
+                    if timeout <= 0:
+                        break
+                    try:
+                        batch.append(
+                            await asyncio.wait_for(self.queue.get(), timeout)
+                        )
+                    except asyncio.TimeoutError:
+                        break
+                await self._run_batch(loop, batch)
+            except asyncio.CancelledError:
+                # stop() drains self.queue, but requests already popped
+                # into this in-progress batch are in neither the queue
+                # nor _run_batch — fail them here or their submit()
+                # callers hang past shutdown grace.
+                for _, _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(
+                            RuntimeError("speculative batcher stopped")
+                        )
+                raise
 
     def _fit_limit(self) -> int:
         return min(

