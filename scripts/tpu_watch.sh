@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Watch for the axon TPU tunnel to come back, then capture the
+# on-chip evidence in one shot:
+#   1. flash-vs-XLA attention table  -> /tmp/attn_bench.txt
+#   2. full-stack TPU benchmark line -> /tmp/bench_tpu.json
+# Probes in a subprocess with its own timeout (a wedged tunnel hangs
+# uninterruptibly inside backend init). Gives up after MAX_WAIT_S.
+set -u
+cd "$(dirname "$0")/.."
+MAX_WAIT_S=${MAX_WAIT_S:-18000}
+PROBE_EVERY_S=${PROBE_EVERY_S:-300}
+start=$(date +%s)
+while true; do
+  now=$(date +%s)
+  if (( now - start > MAX_WAIT_S )); then
+    echo "tpu_watch: gave up after ${MAX_WAIT_S}s" >&2
+    exit 1
+  fi
+  if timeout 120 python -c "
+import jax
+assert jax.devices()[0].platform == 'tpu'
+print('PROBE-OK')" 2>/dev/null | grep -q PROBE-OK; then
+    echo "tpu_watch: TPU is back ($(date -u +%H:%M:%S))" >&2
+    break
+  fi
+  echo "tpu_watch: still down ($(date -u +%H:%M:%S))" >&2
+  sleep "$PROBE_EVERY_S"
+done
+
+echo "tpu_watch: running attention bench" >&2
+timeout 900 python scripts/bench_attention.py --iters 10 \
+  --seqs 256 512 1024 2048 4096 > /tmp/attn_bench.txt 2>/tmp/attn_bench.err
+echo "tpu_watch: attention bench rc=$?" >&2
+
+echo "tpu_watch: running full-stack bench" >&2
+GGRMCP_BENCH_BUDGET_S=1200 timeout 1300 python bench.py \
+  > /tmp/bench_tpu.json 2>/tmp/bench_tpu.err
+echo "tpu_watch: bench rc=$?" >&2
+echo "tpu_watch: done" >&2
