@@ -96,6 +96,33 @@ async def test_mixed_lengths_generate(engine):
         await tiered.stop()
 
 
+async def test_long_prompt_chunked_into_long_tier(engine):
+    """Composition of the long-context pieces: a prompt that (a) routes
+    to the long tier and (b) exceeds prefill_chunk — so it admits via
+    CHUNKED prefill inside the tier — must produce exactly the fused
+    whole-prompt greedy output."""
+    prompt = [(i * 7 + 3) % 500 + 1 for i in range(100)]
+    expected, _ = engine.generate([prompt], max_new_tokens=5, seed=0)
+
+    tiered = TieredBatcher(
+        engine,
+        BatchingConfig(
+            kv_tiers=TIERS, max_queue_delay_ms=1.0, prefill_chunk=32
+        ),
+    )
+    assert tiered._route(len(prompt), 5) is tiered.tiers[-1]
+    tiered.start()
+    try:
+        out: list[int] = []
+        async for ids, _reason in tiered.submit(
+            prompt, 5, SamplingConfig(temperature=0.0)
+        ):
+            out.extend(ids)
+        assert out == expected[0]
+    finally:
+        await tiered.stop()
+
+
 async def test_sidecar_with_tiers():
     import grpc
     import grpc.aio
