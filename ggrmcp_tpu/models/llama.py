@@ -177,6 +177,7 @@ def attention_block(
     cache_v: Optional[jnp.ndarray],
     cache_len: Optional[jnp.ndarray],  # [B]
     use_flash: Optional[bool] = None,
+    flash_mesh: Any = None,
     attn_impl: Optional[Any] = None,
 ):
     """Pre-norm GQA attention with residual; shared by the dense and MoE
@@ -234,7 +235,7 @@ def attention_block(
     else:
         attn_out = attention(
             q, k_all, v_all, causal=True, q_offset=q_offset, kv_len=kv_len,
-            use_flash=use_flash,
+            use_flash=use_flash, flash_mesh=flash_mesh,
         )
     attn_out = qmatmul(attn_out.reshape(b, s, h * hd), layer_params["wo"])
     x = x + attn_out
@@ -253,11 +254,12 @@ def _layer(
     cache_v: Optional[jnp.ndarray],
     cache_len: Optional[jnp.ndarray],
     use_flash: Optional[bool] = None,
+    flash_mesh: Any = None,
     attn_impl: Optional[Any] = None,
 ):
     x, new_cache = attention_block(
         x, layer_params, cfg, positions, cache_k, cache_v, cache_len,
-        use_flash=use_flash, attn_impl=attn_impl,
+        use_flash=use_flash, flash_mesh=flash_mesh, attn_impl=attn_impl,
     )
 
     # SwiGLU MLP
@@ -275,6 +277,7 @@ def forward(
     tokens: jnp.ndarray,  # [B, S]
     cache: Optional[KVCache] = None,
     use_flash: Optional[bool] = None,
+    flash_mesh: Any = None,
     attn_impl: Optional[Any] = None,
 ) -> tuple[jnp.ndarray, Optional[KVCache]]:
     """Run the decoder. Without a cache: plain causal forward (training/
@@ -303,7 +306,8 @@ def forward(
         def body(x, layer_params):
             x, _ = _layer(
                 x, layer_params, cfg, positions, None, None, None,
-                use_flash=use_flash, attn_impl=attn_impl,
+                use_flash=use_flash, flash_mesh=flash_mesh,
+                attn_impl=attn_impl,
             )
             return x, None
 
@@ -315,7 +319,8 @@ def forward(
             layer_params, ck, cv = scanned
             x, (ck, cv) = _layer(
                 x, layer_params, cfg, positions, ck, cv, cache.length,
-                use_flash=use_flash, attn_impl=attn_impl,
+                use_flash=use_flash, flash_mesh=flash_mesh,
+                attn_impl=attn_impl,
             )
             return x, (ck, cv)
 

@@ -249,11 +249,11 @@ def moe_ffn(
 
 def _layer(
     x, layer_params, cfg, positions, cache_k, cache_v, cache_len, valid,
-    use_flash=None,
+    use_flash=None, flash_mesh=None,
 ):
     x, new_cache = attention_block(
         x, layer_params, cfg, positions, cache_k, cache_v, cache_len,
-        use_flash=use_flash,
+        use_flash=use_flash, flash_mesh=flash_mesh,
     )
     normed = common.rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
     ffn_out, aux = moe_ffn(normed, layer_params, cfg, valid)
@@ -267,12 +267,14 @@ def forward(
     cache: Optional[KVCache] = None,
     valid: Optional[jnp.ndarray] = None,  # [B, S] bool
     use_flash: Optional[bool] = None,
+    flash_mesh=None,
 ) -> tuple[jnp.ndarray, Optional[KVCache]]:
     """Same contract as `llama.forward` — the engines treat both
     families interchangeably. `valid` marks real (non-padding) tokens
     so padding never competes for expert capacity."""
     logits, cache, _ = forward_with_aux(
-        params, cfg, tokens, cache, valid, use_flash=use_flash
+        params, cfg, tokens, cache, valid, use_flash=use_flash,
+        flash_mesh=flash_mesh,
     )
     return logits, cache
 
@@ -284,6 +286,7 @@ def forward_with_aux(
     cache: Optional[KVCache] = None,
     valid: Optional[jnp.ndarray] = None,
     use_flash: Optional[bool] = None,
+    flash_mesh=None,
 ) -> tuple[jnp.ndarray, Optional[KVCache], jnp.ndarray]:
     """Forward returning the mean router load-balance loss (training)."""
     b, s = tokens.shape
@@ -301,7 +304,7 @@ def forward_with_aux(
         def body(x, layer_params):
             x, _, aux = _layer(
                 x, layer_params, cfg, positions, None, None, None, valid,
-                use_flash=use_flash,
+                use_flash=use_flash, flash_mesh=flash_mesh,
             )
             return x, aux
 
@@ -313,7 +316,7 @@ def forward_with_aux(
             layer_params, ck, cv = scanned
             x, (ck, cv), aux = _layer(
                 x, layer_params, cfg, positions, ck, cv, cache.length, valid,
-                use_flash=use_flash,
+                use_flash=use_flash, flash_mesh=flash_mesh,
             )
             return x, ((ck, cv), aux)
 

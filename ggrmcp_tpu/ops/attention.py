@@ -230,6 +230,80 @@ def flash_attention(
 
 
 # ---------------------------------------------------------------------------
+# Multi-device flash: shard_map over batch/head axes
+# ---------------------------------------------------------------------------
+
+
+def _flash_shardable(mesh, batch: int, kv_heads: int) -> tuple[bool, str]:
+    """ONE predicate for whether flash can run per shard on `mesh` for
+    these shapes — shared by the dispatcher (silent XLA fallback) and
+    flash_attention_sharded (loud error), so they cannot diverge."""
+    d_ax = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    t_ax = mesh.shape.get("tensor", 1)
+    if batch % d_ax != 0:
+        return False, f"batch {batch} not divisible by data axes {d_ax}"
+    if kv_heads % t_ax != 0:
+        return False, f"kv heads {kv_heads} not divisible by tensor axis {t_ax}"
+    return True, ""
+
+
+def flash_attention_sharded(
+    q: jnp.ndarray,  # [B, Sq, H, D]
+    k: jnp.ndarray,  # [B, Sk, KVH, D]
+    v: jnp.ndarray,
+    mesh,
+    causal: bool = True,
+    q_offset: Optional[jnp.ndarray] = None,
+    kv_len: Optional[jnp.ndarray] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """`flash_attention` on a multi-device mesh: the kernel is a custom
+    call GSPMD cannot partition, so shard manually — batch over
+    `data`/`fsdp`, heads over `tensor` — and run the single-device
+    kernel per shard. Attention is embarrassingly parallel over batch
+    and heads, so no collectives are needed inside.
+
+    Constraints (checked): the data axes divide B; `tensor` divides the
+    KV head count (each shard keeps whole GQA groups). The sequence
+    dims stay local — long-sequence sharding is ring/Ulysses territory
+    (ops/ring_attention.py). Must run under jit (partial-manual
+    shard_map with manual-axis out_specs is rejected eagerly by this
+    JAX version)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    b = q.shape[0]
+    ok, why = _flash_shardable(mesh, b, k.shape[2])
+    if not ok:
+        raise ValueError(why)
+
+    if q_offset is None:
+        q_offset = jnp.zeros((b,), jnp.int32)
+    if kv_len is None:
+        kv_len = jnp.full((b,), k.shape[1], jnp.int32)
+
+    bspec = P(("data", "fsdp"), None, "tensor", None)
+    sspec = P(("data", "fsdp"))
+
+    def local(q, k, v, qo, kl):
+        return flash_attention(
+            q, k, v, causal=causal, q_offset=qo, kv_len=kl,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        axis_names={"data", "fsdp", "tensor"},
+        in_specs=(bspec, bspec, bspec, sspec, sspec),
+        out_specs=bspec,
+        check_vma=False,
+    )(q, k, v, q_offset.astype(jnp.int32), kv_len.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
 # Dispatcher
 # ---------------------------------------------------------------------------
 
@@ -247,15 +321,17 @@ def attention(
     q_offset: Optional[jnp.ndarray] = None,
     kv_len: Optional[jnp.ndarray] = None,
     use_flash: Optional[bool] = None,
+    flash_mesh=None,
 ) -> jnp.ndarray:
     """Pick the right implementation for the shapes at hand. GQA is
     handled here: the flash kernel reads the shared KV heads in place;
     the XLA path repeats them (XLA materializes the repeat either way).
 
     `use_flash=None` means auto: flash for long prefill on a TPU.
-    Engines running on multi-device meshes pass False — the kernel is
-    a custom call GSPMD cannot partition; a shard_map wrapper is the
-    multi-chip path (docs/perf_attention.md)."""
+    On multi-device meshes the kernel is a custom call GSPMD cannot
+    partition: engines either pass False (XLA path) or supply
+    `flash_mesh` and the kernel runs per shard via shard_map —
+    batch over data/fsdp, heads over tensor (flash_attention_sharded)."""
     sq, sk = q.shape[1], k.shape[1]
     if use_flash is None:
         use_flash = (
@@ -264,6 +340,13 @@ def attention(
             and sq % 128 == 0
             and sk % 128 == 0
         )
+    if use_flash and flash_mesh is not None:
+        if _flash_shardable(flash_mesh, q.shape[0], k.shape[2])[0]:
+            return flash_attention_sharded(
+                q, k, v, flash_mesh, causal=causal,
+                q_offset=q_offset, kv_len=kv_len,
+            )
+        use_flash = False  # per-call shapes don't shard; fall through
     if use_flash:
         return flash_attention(
             q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len

@@ -47,7 +47,8 @@ def speculative_generate(
     gamma: int,
     eos_id,
     max_new=None,  # traced per-call cap ≤ max_new_budget (None → budget)
-    use_flash=None,  # threaded to forward (False on multi-device meshes)
+    use_flash=None,  # threaded to forward (see engine flash policy)
+    flash_mesh=None,
 ) -> SpecResult:
     """Generate up to `max_new` tokens per row, greedy, speculative.
 
@@ -72,10 +73,10 @@ def speculative_generate(
 
     # Prefill both models on the prompt.
     tlogits, tcache = target_fam.forward(
-        target_params, target_cfg, tokens, tcache, use_flash=use_flash
+        target_params, target_cfg, tokens, tcache, use_flash=use_flash, flash_mesh=flash_mesh
     )
     _, dcache = draft_fam.forward(
-        draft_params, draft_cfg, tokens, dcache, use_flash=use_flash
+        draft_params, draft_cfg, tokens, dcache, use_flash=use_flash, flash_mesh=flash_mesh
     )
     last_idx = jnp.maximum(true_len - 1, 0)
     first = jnp.argmax(
@@ -112,14 +113,16 @@ def speculative_generate(
         # cur extends), then gamma-1 single-token steps.
         two = jnp.stack([prev, cur], axis=1)  # [B, 2]
         dlogits, dcache2 = draft_fam.forward(
-            draft_params, draft_cfg, two, dcache, use_flash=use_flash
+            draft_params, draft_cfg, two, dcache, use_flash=use_flash,
+            flash_mesh=flash_mesh,
         )
         d1 = jnp.argmax(dlogits[:, -1], axis=-1).astype(jnp.int32)
 
         def draft_step(c, _):
             tok, dc = c
             lg, dc = draft_fam.forward(
-                draft_params, draft_cfg, tok[:, None], dc, use_flash=use_flash
+                draft_params, draft_cfg, tok[:, None], dc,
+                use_flash=use_flash, flash_mesh=flash_mesh,
             )
             nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
             return (nxt, dc), nxt
@@ -135,7 +138,8 @@ def speculative_generate(
         # --- target verifies in ONE forward --------------------------
         verify_in = jnp.concatenate([cur[:, None], proposals], axis=1)
         vlogits, tcache2 = target_fam.forward(
-            target_params, target_cfg, verify_in, tcache, use_flash=use_flash
+            target_params, target_cfg, verify_in, tcache,
+            use_flash=use_flash, flash_mesh=flash_mesh,
         )
         greedy = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, gamma+1]
         # greedy[:, i] is the target's token AFTER verify_in[:, i]:

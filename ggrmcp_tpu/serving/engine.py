@@ -117,9 +117,24 @@ class GenerationEngine:
             self.serving.mesh
         )
         # The Pallas flash kernel is a custom call GSPMD cannot
-        # partition — auto-select (None) only on single-device meshes;
-        # multi-device forces the XLA path (ops/attention.py).
-        self.use_flash = None if self.mesh.devices.size == 1 else False
+        # partition. Single-device: auto-select (None). Multi-device
+        # TPU meshes whose sharding the kernel CAN take manually
+        # (batch over data/fsdp, heads over tensor; no sequence/
+        # expert/stage sharding) get flash via the shard_map wrapper
+        # (flash_attention_sharded); anything else forces XLA.
+        if self.mesh.devices.size == 1:
+            self.use_flash, self.flash_mesh = None, None
+        else:
+            sizes = self.mesh.shape
+            shardable = (
+                self.mesh.devices.flat[0].platform == "tpu"
+                and cfg.num_kv_heads % sizes.get("tensor", 1) == 0
+                and sizes.get("sequence", 1) == 1
+                and sizes.get("expert", 1) == 1
+                and sizes.get("stage", 1) == 1
+            )
+            self.flash_mesh = self.mesh if shardable else None
+            self.use_flash = None if shardable else False
         self._init_sp_prefill()
         self._init_pp_serving()
         param_specs = (
@@ -249,10 +264,11 @@ class GenerationEngine:
         if self.fam is moe_mod:
             return self.fam.forward(
                 params, self.cfg, tokens, cache, valid=valid,
-                use_flash=self.use_flash,
+                use_flash=self.use_flash, flash_mesh=self.flash_mesh,
             )
         return self.fam.forward(
-            params, self.cfg, tokens, cache, use_flash=self.use_flash
+            params, self.cfg, tokens, cache, use_flash=self.use_flash,
+            flash_mesh=self.flash_mesh,
         )
 
     def _init_speculative(self, seed: int) -> None:
@@ -317,7 +333,7 @@ class GenerationEngine:
             self.draft_fam, self.draft_params, self.draft_cfg,
             tokens, true_len, max_new_budget,
             self.serving.speculative_gamma, eos_id, max_new=max_new,
-            use_flash=self.use_flash,
+            use_flash=self.use_flash, flash_mesh=self.flash_mesh,
         )
 
     def warmup_speculative(self, max_new_budget: int = 64) -> None:

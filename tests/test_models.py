@@ -107,6 +107,81 @@ class TestOps:
         )
         np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
 
+    def test_flash_sharded_matches_xla(self):
+        """shard_map-wrapped flash (batch over data, heads over tensor)
+        must match the XLA path — the multi-chip flash route."""
+        from functools import partial
+
+        from ggrmcp_tpu.core.config import MeshConfig
+        from ggrmcp_tpu.ops.attention import flash_attention_sharded
+        from ggrmcp_tpu.parallel import mesh as mesh_mod
+
+        mesh = mesh_mod.build_mesh(MeshConfig(data=2, tensor=4))
+        key = jax.random.PRNGKey(8)
+        b, s, h, kvh, d = 4, 128, 8, 4, 32
+        q = jax.random.normal(key, (b, s, h, d))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (b, s, kvh, d))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (b, s, kvh, d))
+        q_offset = jnp.array([0, 0, 32, 16], jnp.int32)
+        kv_len = jnp.array([128, 96, 64, 128], jnp.int32)
+        ref = attention_xla(
+            q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2),
+            causal=True, q_offset=q_offset, kv_len=kv_len,
+        )
+        out = jax.jit(
+            partial(
+                flash_attention_sharded, mesh=mesh, causal=True,
+                block_q=64, block_k=64, interpret=True,
+            )
+        )(q, k, v, q_offset=q_offset, kv_len=kv_len)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3, rtol=2e-3)
+
+    def test_dispatcher_flash_mesh_route_and_fallback(self):
+        """attention(..., use_flash=True, flash_mesh=...) must take the
+        sharded route for shardable shapes and silently fall back to
+        XLA for per-call shapes the mesh can't take (odd batch)."""
+        from functools import partial
+
+        from ggrmcp_tpu.core.config import MeshConfig
+        from ggrmcp_tpu.ops.attention import attention
+        from ggrmcp_tpu.parallel import mesh as mesh_mod
+
+        mesh = mesh_mod.build_mesh(MeshConfig(data=2, tensor=4))
+        key = jax.random.PRNGKey(11)
+
+        def run(b):
+            q = jax.random.normal(key, (b, 128, 8, 32))
+            k = jax.random.normal(jax.random.fold_in(key, 1), (b, 128, 4, 32))
+            v = jax.random.normal(jax.random.fold_in(key, 2), (b, 128, 4, 32))
+            ref = attention_xla(
+                q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2),
+                causal=True,
+            )
+            out = jax.jit(
+                partial(attention, use_flash=True, flash_mesh=mesh)
+            )(q, k, v)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-3, rtol=2e-3)
+
+        run(4)  # shardable → flash_attention_sharded (interpret on CPU)
+        run(3)  # batch 3 % data 2 != 0 → silent XLA fallback
+
+    def test_flash_sharded_rejects_bad_shapes(self):
+        from ggrmcp_tpu.core.config import MeshConfig
+        from ggrmcp_tpu.ops.attention import flash_attention_sharded
+        from ggrmcp_tpu.parallel import mesh as mesh_mod
+
+        mesh = mesh_mod.build_mesh(MeshConfig(data=2, tensor=4))
+        q = jnp.zeros((3, 128, 8, 32))  # batch 3 % data 2 != 0
+        k = jnp.zeros((3, 128, 4, 32))
+        with pytest.raises(ValueError, match="divisible"):
+            flash_attention_sharded(q, k, k, mesh)
+        q = jnp.zeros((4, 128, 8, 32))
+        k = jnp.zeros((4, 128, 2, 32))  # kvh 2 % tensor 4 != 0
+        with pytest.raises(ValueError, match="kv heads"):
+            flash_attention_sharded(q, k, k, mesh)
+
     def test_attention_dispatcher_gqa(self):
         # The dispatcher accepts narrow K/V and repeats for the XLA path.
         from ggrmcp_tpu.ops.attention import attention
