@@ -48,6 +48,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--quantize", default=None, help="sidecar weight quantization (int8)"
     )
     gw.add_argument(
+        "--hf-checkpoint", default=None,
+        help="sidecar HF Llama checkpoint dir (with --tpu); overrides --model",
+    )
+    gw.add_argument(
+        "--tokenizer", default=None,
+        help="sidecar HuggingFace tokenizer.json path (with --tpu)",
+    )
+    gw.add_argument(
         "--workers", type=int, default=None,
         help="gateway worker processes sharing the port (SO_REUSEPORT)",
     )
@@ -76,6 +84,14 @@ def build_parser() -> argparse.ArgumentParser:
     sc.add_argument("--model", default=None, help="model registry key")
     sc.add_argument(
         "--quantize", default=None, help="weight quantization (int8)"
+    )
+    sc.add_argument(
+        "--hf-checkpoint", default=None,
+        help="HuggingFace Llama checkpoint dir (config.json + "
+        "safetensors); overrides --model",
+    )
+    sc.add_argument(
+        "--tokenizer", default=None, help="HuggingFace tokenizer.json path"
     )
     sc.add_argument("--config", default=None, help="YAML/JSON config file")
     sc.add_argument("--log-level", default=None)
@@ -106,6 +122,10 @@ def load_config(args: argparse.Namespace) -> cfgmod.Config:
         cfg.serving.quantize = args.quantize
     if getattr(args, "port", None):
         cfg.serving.port = args.port
+    if getattr(args, "hf_checkpoint", None):
+        cfg.serving.hf_checkpoint_path = args.hf_checkpoint
+    if getattr(args, "tokenizer", None):
+        cfg.serving.tokenizer_path = args.tokenizer
     if getattr(args, "workers", None):
         cfg.server.workers = args.workers
     cfg.validate()

@@ -135,6 +135,44 @@ def test_tied_embeddings(tmp_path):
     )
 
 
+async def test_sidecar_serves_hf_checkpoint(tmp_path):
+    """End to end: a sidecar configured with hf_checkpoint_path loads
+    the converted weights (architecture from config.json) and serves
+    generation — the reference's real-upstream posture."""
+    import grpc
+    import grpc.aio
+
+    from ggrmcp_tpu.core.config import MeshConfig, ServingConfig
+    from ggrmcp_tpu.rpc.pb import serving_pb2
+    from ggrmcp_tpu.serving.sidecar import Sidecar
+
+    _, path = _tiny_hf_model(tmp_path)
+    side = Sidecar(
+        ServingConfig(
+            hf_checkpoint_path=path, mesh=MeshConfig(tensor=1, data=0)
+        )
+    )
+    assert side.generation is not None
+    assert side.generation.cfg.hidden_dim == 64  # from config.json
+    port = await side.start(0)
+    channel = grpc.aio.insecure_channel(f"localhost:{port}")
+    try:
+        gen = channel.unary_unary(
+            "/ggrmcp.tpu.GenerateService/Generate",
+            request_serializer=serving_pb2.GenerateRequest.SerializeToString,
+            response_deserializer=serving_pb2.GenerateResponse.FromString,
+        )
+        resp = await gen(
+            serving_pb2.GenerateRequest(
+                prompt="hf", max_new_tokens=4, return_tokens=True
+            )
+        )
+        assert 0 < resp.completion_tokens <= 4
+    finally:
+        await channel.close()
+        await side.stop()
+
+
 def test_sharded_index_layout(tmp_path):
     """The multi-file index.json layout loads identically."""
     _, path = _tiny_hf_model(tmp_path)
