@@ -15,6 +15,9 @@ Environment knobs:
   GGRMCP_BENCH_SESSIONS  concurrent MCP sessions (default 16)
   GGRMCP_BENCH_CALLS     total tool calls (default 10 * sessions)
   GGRMCP_BENCH_NEW_TOKENS max_new_tokens per call (default 16)
+  GGRMCP_BENCH_QUANT     serving weight quantization: "" (bf16, default)
+                         or "int8" (halves weight-streaming HBM traffic,
+                         the decode bottleneck at small batch)
   GGRMCP_BENCH_CPU=1     force the CPU platform (tiny model)
 """
 
@@ -168,8 +171,10 @@ async def _run_bench() -> dict:
     tick_steps = int(
         os.environ.get("GGRMCP_BENCH_TICK_STEPS", "8" if on_tpu else "1")
     )
+    quantize = os.environ.get("GGRMCP_BENCH_QUANT", "")
     serving = ServingConfig(
         model=model,
+        quantize=quantize,
         mesh=MeshConfig(tensor=0),  # all local devices on the tensor axis
         batching=BatchingConfig(
             max_batch_size=min(32, max(8, sessions)),
@@ -314,6 +319,7 @@ async def _run_bench() -> dict:
         "chips": n_chips,
         "calls_per_sec_per_chip": round(calls_per_sec / n_chips, 2),
         "model": model,
+        "quantize": quantize or "bf16",
         "tokenizer": serving.tokenizer_path or "byte-level",
         "sessions": sessions,
         "total_calls": total,
@@ -452,6 +458,13 @@ def _cpu_fallback(reason: str) -> None:
 
 
 def main() -> None:
+    from ggrmcp_tpu.core.config import QUANTIZE_MODES
+
+    if os.environ.get("GGRMCP_BENCH_QUANT", "") not in QUANTIZE_MODES:
+        raise SystemExit(
+            f"GGRMCP_BENCH_QUANT must be one of {QUANTIZE_MODES}, "
+            f"got {os.environ['GGRMCP_BENCH_QUANT']!r}"
+        )
     budget_s = float(os.environ.get("GGRMCP_BENCH_BUDGET_S", "1500"))
     on_cpu = os.environ.get("GGRMCP_BENCH_CPU") == "1"
     if not on_cpu:

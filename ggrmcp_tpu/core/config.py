@@ -307,6 +307,11 @@ class TrainingConfig:
     seed: int = 0
 
 
+# Supported serving.quantize modes — the single source of truth for
+# config.validate(), the engine's apply-time re-check, and bench knobs.
+QUANTIZE_MODES = ("", "int8")
+
+
 @dataclass
 class ServingConfig:
     model: str = "tiny-llama"  # registry key in ggrmcp_tpu.models
@@ -457,7 +462,7 @@ class Config:
                 f"unknown serving.sp_prefill {self.serving.sp_prefill!r}; "
                 f"supported: 'ring', 'ulysses'"
             )
-        if self.serving.quantize not in ("", "int8"):
+        if self.serving.quantize not in QUANTIZE_MODES:
             # Catch typos at parse time, before minutes of checkpoint
             # loading (the engine re-checks at apply time).
             raise ValueError(

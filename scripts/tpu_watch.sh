@@ -35,5 +35,15 @@ echo "tpu_watch: attention bench rc=$?" >&2
 echo "tpu_watch: running full-stack bench" >&2
 GGRMCP_BENCH_BUDGET_S=1200 timeout 1300 python bench.py \
   > /tmp/bench_tpu.json 2>/tmp/bench_tpu.err
-echo "tpu_watch: bench rc=$?" >&2
+rc=$?
+echo "tpu_watch: bench rc=$rc" >&2
+
+# Best-effort int8 phase once the bf16 headline is in the bag (decode
+# is weight-streaming-bound; int8 shows the quantized serving path).
+if [ "$rc" -eq 0 ] && grep -q '"platform": "tpu"' /tmp/bench_tpu.json; then
+  echo "tpu_watch: running int8 bench" >&2
+  GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_BUDGET_S=900 timeout 1000 \
+    python bench.py > /tmp/bench_tpu_int8.json 2>/tmp/bench_tpu_int8.err
+  echo "tpu_watch: int8 bench rc=$?" >&2
+fi
 echo "tpu_watch: done" >&2
