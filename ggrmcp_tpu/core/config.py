@@ -282,6 +282,17 @@ class BatchingConfig:
     # routes each request to the smallest tier that fits it
     # (serving/tiered.py).
     kv_tiers: list = field(default_factory=list)
+    # Prefix (prompt-KV) cache: a device-resident pool of recently seen
+    # prompt prefixes; an admission whose prompt starts with a cached
+    # prefix reuses its KV and prefills only the suffix — the
+    # system-prompt case. 0 entries = off (serving/batching.py).
+    # NOTE: with kv_tiers, EACH tier owns an independent pool (tiers
+    # share no mutable state): HBM is tiers × entries × max_seq of KV
+    # and a prefix shared across tiers is stored once per tier. Budget
+    # entries accordingly when tiering.
+    prefix_cache_entries: int = 0
+    prefix_cache_max_seq: int = 512  # per-entry KV capacity (tokens)
+    prefix_cache_min_seq: int = 64  # don't pool prefixes shorter than this
 
 
 @dataclass
@@ -456,6 +467,16 @@ class Config:
                 raise ValueError(
                     "decode_steps_per_tick must be < the smallest tier's "
                     "max_seq"
+                )
+        batching = self.serving.batching
+        if batching.prefix_cache_entries < 0:
+            raise ValueError("prefix_cache_entries must be >= 0")
+        if batching.prefix_cache_entries:
+            if batching.prefix_cache_min_seq < 1:
+                raise ValueError("prefix_cache_min_seq must be >= 1")
+            if batching.prefix_cache_max_seq < batching.prefix_cache_min_seq:
+                raise ValueError(
+                    "prefix_cache_max_seq must be >= prefix_cache_min_seq"
                 )
         if self.serving.sp_prefill not in ("", "ring", "ulysses"):
             raise ValueError(

@@ -108,6 +108,33 @@ class ContinuousBatcher:
         self.fam = getattr(engine, "fam", llama_mod)
         self._is_moe = self.fam is not llama_mod
 
+        # Prefix (prompt-KV) cache: pool entries shaped like mini-cache
+        # rows so a hit is ONE dynamic_update_slice into the admission
+        # mini cache. Host maps (token tuples, lengths, LRU stamps) are
+        # touched only inside this batcher's serialized executor calls
+        # (docs/threading.md — batcher-owned state, no new contexts).
+        pe = self.cfg.prefix_cache_entries
+        self._pfx_max = min(self.cfg.prefix_cache_max_seq, s_max)
+        self._pfx_min = max(1, self.cfg.prefix_cache_min_seq)
+        # A storable prompt needs _pfx_min+1 tokens AND must be
+        # admissible: fit_request caps prompts at s_max minus the tick
+        # overshoot reserve, max_new (>= 1), and the next position.
+        poolable = (
+            self._pfx_min + 1 <= s_max - (self._steps_per_tick - 1) - 2
+        )
+        if pe > 0 and poolable:
+            self._pfx_pool = engine.make_cache(pe, self._pfx_max)
+            self._pfx_keys: list[Optional[np.ndarray]] = [None] * pe
+            self._pfx_used = [0] * pe  # LRU stamps
+            self._pfx_clock = 0
+        else:
+            # Also lands here when this pool's cache is too short for
+            # any admissible poolable prefix (a small kv tier): no
+            # entries, no HBM.
+            self._pfx_pool = None
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+
         # jitted: one decode tick for the whole slot pool
         self._tick = jax.jit(self._tick_impl, donate_argnums=(1,))
         # jitted admission — fused prefill + first-token sample + cache
@@ -126,6 +153,14 @@ class ContinuousBatcher:
         self._chunk_step = jax.jit(self._chunk_step_impl, donate_argnums=(2,))
         self._insert_row = jax.jit(self._insert_row_impl, donate_argnums=(0,))
         self._first_token = jax.jit(self._first_token_impl)
+        # Prefix-pool store/load. The POOL is deliberately NOT donated:
+        # stores are rare (first sighting of a prefix), entries are
+        # small, and an undonated pool stays valid if a call fails. The
+        # load's fresh mini IS donated — its caller always reassigns,
+        # and without donation every hit would allocate + copy a dead
+        # full-size [1, S_max] KV row.
+        self._pfx_store = jax.jit(self._pfx_store_impl)
+        self._pfx_load = jax.jit(self._pfx_load_impl, donate_argnums=(0,))
 
     # -- jitted bodies ------------------------------------------------------
 
@@ -220,22 +255,181 @@ class ContinuousBatcher:
         last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
         return sample_dynamic(last, seeds, jnp.int32(0), temps, ks, ps)
 
-    def _prefill_chunked(self, slot_idx: int, request: _Request) -> None:
-        """Admission for a long prompt: fixed-size chunks into a
-        full-length mini cache, then one insert + one sample."""
+    def _pfx_store_impl(self, pool, mini, entry, plen):
+        """Copy the first `_pfx_max` cache positions of a fully
+        prefilled mini row into pool entry `entry` (the same row-merge
+        as slot insertion, with the mini clipped to the pool width)."""
+        m = self._pfx_max
+        clipped = llama_mod.KVCache(
+            k=mini.k[:, :, :m], v=mini.v[:, :, :m], length=mini.length
+        )
+        return _merge_row(pool, clipped, entry, plen)
+
+    def _pfx_load_impl(self, mini, pool, entry, plen):
+        """Write pool entry `entry` into a fresh mini cache's head and
+        set its length to the prefix length: the chunked prefill then
+        extends from position `plen` exactly as if the prefix had just
+        been prefilled. Stale pool positions past `plen` are overwritten
+        by the suffix chunks or masked by the final length."""
+        pk = jax.lax.dynamic_slice_in_dim(pool.k, entry, 1, axis=1)
+        pv = jax.lax.dynamic_slice_in_dim(pool.v, entry, 1, axis=1)
+        k = jax.lax.dynamic_update_slice(
+            mini.k, pk.astype(mini.k.dtype), (0, 0, 0, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            mini.v, pv.astype(mini.v.dtype), (0, 0, 0, 0, 0)
+        )
+        return llama_mod.KVCache(
+            k=k, v=v, length=jnp.full((1,), plen, jnp.int32)
+        )
+
+    # -- prefix-pool host side (executor-serialized, batcher-owned) ---------
+
+    @staticmethod
+    def _lcp(a: np.ndarray, b: np.ndarray, limit: int) -> int:
+        m = min(len(a), len(b), limit)
+        neq = np.nonzero(a[:m] != b[:m])[0]
+        return int(neq[0]) if neq.size else m
+
+    def _pfx_plan(
+        self, n: int, plen: int
+    ) -> tuple[int, list[tuple[int, int]]]:
+        """Prefill step geometry for an n-token prompt whose first
+        `plen` positions are pooled: the reuse point `start` (0 = the
+        pooled KV is unusable) and the (offset, width) prefill steps
+        covering [start, n). Every step writes its full [1, width]
+        block at the cache offset, so offset + width must stay inside
+        the mini cache (dynamic_update_slice would clamp the start and
+        silently overwrite the prefix), and every non-final step must
+        be completely filled with real tokens (intermediate cache
+        lengths count the whole block). Short suffixes run as ONE
+        bucketed step whose start is lowered until it fits; long
+        suffixes take one bucketed BRIDGE step from below the hit point
+        to the next chunk boundary, then re-enter the fixed chunk grid
+        — either way reuse is plen minus at most a bucket's rounding."""
+        c = min(self.cfg.prefill_chunk, self.max_seq)
+        if n - plen <= c:
+            width = bucket_len(n - plen, maximum=self.max_seq)
+            start = max(0, min(plen, self.max_seq - width))
+            return start, [(start, bucket_len(n - start, maximum=self.max_seq))]
+        boundary = (plen // c + 1) * c
+        width = bucket_len(boundary - plen, maximum=self.max_seq)
+        start = boundary - width
+        if start >= 0:
+            return start, [(start, width)] + [
+                (off, c) for off in range(boundary, n, c)
+            ]
+        # Tiny chunk sizes: no alignment possible.
+        return 0, [(off, c) for off in range(0, n, c)]
+
+    def _pfx_lookup(self, prompt: list[int]) -> Optional[tuple[int, int]]:
+        """Entry with the longest common prefix against `prompt` —
+        partial reuse: a hit at lcp < entry length loads the entry and
+        recomputes only from the divergence point. The match is capped
+        at len(prompt)-1 (at least one suffix token must run through
+        the model to produce sampling logits), and a match the step
+        geometry cannot reuse (plan start 0) is not a hit — it neither
+        refreshes the LRU stamp nor diverts the request from fused
+        admission. Returns (entry, prefix_len) or None."""
+        if self._pfx_pool is None:
+            return None
+        arr = np.asarray(prompt[: self._pfx_max], np.int32)
+        limit = len(prompt) - 1
+        best: Optional[tuple[int, int]] = None
+        for e, key in enumerate(self._pfx_keys):
+            if key is None:
+                continue
+            lcp = self._lcp(key, arr, limit)
+            if lcp >= self._pfx_min and (best is None or lcp > best[1]):
+                best = (e, lcp)
+        if best is None or self._pfx_plan(len(prompt), best[1])[0] == 0:
+            return None
+        self._pfx_clock += 1
+        self._pfx_used[best[0]] = self._pfx_clock
+        return best
+
+    def _pfx_storable(self, prompt: list[int]) -> Optional[np.ndarray]:
+        """The key this prompt's prefix would pool under, or None if
+        too short. (Whether pooling adds anything over an existing hit
+        is the caller's check — it knows the hit length.)"""
+        if self._pfx_pool is None:
+            return None
+        plen = min(len(prompt) - 1, self._pfx_max)
+        if plen < self._pfx_min:
+            return None
+        return np.asarray(prompt[:plen], np.int32)
+
+    def _pfx_insert(self, mini, key: np.ndarray) -> None:
+        """Pool `key`'s KV out of a fully prefilled mini row, evicting
+        the LRU entry and any entry the new key subsumes. A device
+        failure only skips the caching (the pool is never donated)."""
+        free = [e for e, k in enumerate(self._pfx_keys) if k is None]
+        entry = free[0] if free else min(
+            range(len(self._pfx_keys)), key=lambda e: self._pfx_used[e]
+        )
+        try:
+            pool = self._pfx_store(
+                self._pfx_pool, mini, jnp.int32(entry), jnp.int32(len(key))
+            )
+            jax.block_until_ready(pool.length)
+        except Exception:
+            logger.exception("prefix-pool store failed; entry not cached")
+            return
+        self._pfx_pool = pool
+        self._pfx_keys[entry] = key
+        self._pfx_clock += 1
+        self._pfx_used[entry] = self._pfx_clock
+        for e, other in enumerate(self._pfx_keys):
+            if (
+                e != entry and other is not None
+                and len(other) <= len(key)
+                and self._lcp(other, key, len(key)) == len(other)
+            ):
+                # `key` extends `other`: the shorter entry can never
+                # out-match the new one again.
+                self._pfx_keys[e] = None
+
+    def _prefill_chunked(
+        self,
+        slot_idx: int,
+        request: _Request,
+        pfx: Optional[tuple[int, int]] = None,
+    ) -> None:
+        """Admission for a long or prefix-pooled prompt: fixed-size
+        chunks into a full-length mini cache, then one insert + one
+        sample. With a prefix hit `pfx=(entry, plen)` the pooled KV
+        seeds the mini cache and only prompt[plen:] runs the model."""
         prompt = request.prompt
         n = len(prompt)
         c = min(self.cfg.prefill_chunk, self.max_seq)
         mini = llama_mod.KVCache.create(self.engine.cfg, 1, self.max_seq)
+        start = 0
+        if pfx is not None:
+            # Lookup already rejected geometrically unusable matches,
+            # so start > 0 here (see _pfx_plan for the step rules).
+            entry, plen = pfx
+            start, steps = self._pfx_plan(n, plen)
+            self.prefix_hits += 1
+            mini = self._pfx_load(
+                mini, self._pfx_pool, jnp.int32(entry), jnp.int32(start)
+            )
+        else:
+            steps = [(off, c) for off in range(0, n, c)]
         logits = None
         true_len = jnp.asarray([n], jnp.int32)
-        for off in range(0, n, c):
-            chunk = np.zeros((1, c), np.int32)
-            piece = prompt[off : off + c]
+        for off, width in steps:
+            chunk = np.zeros((1, width), np.int32)
+            piece = prompt[off : off + width]
             chunk[0, : len(piece)] = piece
             logits, mini = self._chunk_step(
                 self.engine.params, jnp.asarray(chunk), mini, true_len
             )
+        # Pool the prefix on first sighting — also when a SHORTER
+        # pooled prefix hit (the mini row holds the full prompt's KV
+        # either way, so the longer entry upgrades future matches).
+        key = self._pfx_storable(prompt)
+        if key is not None and (pfx is None or pfx[1] < len(key)):
+            self._pfx_insert(mini, key)
         mini = mini._replace(length=jnp.asarray([n], jnp.int32))
         self._cache_at_risk = True
         self.cache = self._insert_row(
@@ -247,9 +441,10 @@ class ContinuousBatcher:
         # skip the rebuild of a poisoned cache.
         jax.block_until_ready(self.cache.length)
         self._cache_at_risk = False
-        # Last real token sits at (n-1) % c of the final chunk.
+        # Last real token sits at n - last_step_offset - 1 of the final
+        # step (always < that step's width).
         first = self._first_token(
-            logits, jnp.asarray([(n - 1) % c], jnp.int32),
+            logits, jnp.asarray([n - steps[-1][0] - 1], jnp.int32),
             jnp.asarray([request.seed & 0xFFFFFFFF], jnp.uint32),
             jnp.asarray([request.sampling.temperature], jnp.float32),
             jnp.asarray([request.sampling.top_k], jnp.int32),
@@ -318,8 +513,9 @@ class ContinuousBatcher:
         # Chunked-prefill programs (statically shaped: [1, C] chunk into
         # a [1, S_max] mini cache) — the first long-prompt request must
         # not pay their compiles. Skipped when the chunked path is
-        # unreachable (every admissible prompt fits one chunk).
-        if self.cfg.prefill_chunk < self.max_seq:
+        # unreachable (every admissible prompt fits one chunk and no
+        # prefix pool routes short prompts through it).
+        if self.cfg.prefill_chunk < self.max_seq or self._pfx_pool is not None:
             c = min(self.cfg.prefill_chunk, self.max_seq)
             mini = llama_mod.KVCache.create(self.engine.cfg, 1, self.max_seq)
             logits, mini = self._chunk_step(
@@ -333,6 +529,29 @@ class ContinuousBatcher:
                 logits, jnp.asarray(zi1), jnp.asarray(zseed1),
                 jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
             )
+            if self._pfx_pool is not None:
+                # plen=0 and no host-side key: the warmup entry can
+                # never match a lookup.
+                self._pfx_pool = self._pfx_store(
+                    self._pfx_pool, mini, jnp.int32(0), jnp.int32(0)
+                )
+                # _pfx_load donates its mini: keep the returned one.
+                mini = self._pfx_load(
+                    mini, self._pfx_pool, jnp.int32(0), jnp.int32(0)
+                )
+                # Warm every suffix-step bucket a prefix hit can pick
+                # ([1, 32] .. [1, bucket(c)]) — a hit's first use must
+                # not pay a cold compile mid-request (minutes over a
+                # remote-compile TPU link).
+                width = 32
+                while width <= bucket_len(c, maximum=self.max_seq):
+                    if width != c:
+                        _, mini = self._chunk_step(
+                            self.engine.params,
+                            jnp.asarray(np.zeros((1, width), np.int32)),
+                            mini, jnp.asarray(zlen1),
+                        )
+                    width *= 2
         jax.block_until_ready(self.cache.k)
 
     def start(self) -> None:
@@ -508,23 +727,41 @@ class ContinuousBatcher:
     def _prefill_into_slots(
         self, slots_idx: list[int], batch: list[_Request]
     ) -> None:
+        """Route each admission. Prefix-pool hits, prompts longer than
+        cfg.prefill_chunk, and store-worthy first sightings of a
+        poolable prefix take the chunked path one by one; the rest are
+        fused into one device call."""
+        fused_slots: list[int] = []
+        fused_batch: list[_Request] = []
+        trickle = len(batch) == 1
+        for sl, req in zip(slots_idx, batch):
+            pfx = self._pfx_lookup(req.prompt)
+            if pfx is not None:
+                self._prefill_chunked(sl, req, pfx)
+            elif len(req.prompt) > self.cfg.prefill_chunk or (
+                # First sighting of a poolable prefix: divert through
+                # the chunked path (whose mini cache feeds the pool
+                # store) only on trickle admissions — a burst of
+                # distinct prompts stays ONE fused device call instead
+                # of N serial chunked ones, at the cost of not learning
+                # prefixes from bursts.
+                trickle and self._pfx_storable(req.prompt) is not None
+            ):
+                if self._pfx_pool is not None:
+                    self.prefix_misses += 1
+                self._prefill_chunked(sl, req)
+            else:
+                fused_slots.append(sl)
+                fused_batch.append(req)
+        if fused_batch:
+            self._prefill_fused(fused_slots, fused_batch)
+
+    def _prefill_fused(
+        self, slots_idx: list[int], batch: list[_Request]
+    ) -> None:
         """One fused device call admitting `batch` into `slots_idx`:
         the single-row program for one request, the full-pool program
-        for a burst (row index == slot index). Prompts longer than
-        cfg.prefill_chunk go through the chunked path one by one."""
-        if any(len(req.prompt) > self.cfg.prefill_chunk for req in batch):
-            short = [
-                (sl, req) for sl, req in zip(slots_idx, batch)
-                if len(req.prompt) <= self.cfg.prefill_chunk
-            ]
-            for sl, req in zip(slots_idx, batch):
-                if len(req.prompt) > self.cfg.prefill_chunk:
-                    self._prefill_chunked(sl, req)
-            if short:
-                self._prefill_into_slots(
-                    [sl for sl, _ in short], [req for _, req in short]
-                )
-            return
+        for a burst (row index == slot index)."""
         s = bucket_len(
             max(len(req.prompt) for req in batch), maximum=self.max_seq
         )
@@ -534,7 +771,7 @@ class ContinuousBatcher:
             # Tiny burst: two serial single-row calls beat one full-pool
             # prefill (compute scales with rows; round-trips are ~equal).
             for slot_idx, req in zip(slots_idx, batch):
-                self._prefill_into_slots([slot_idx], [req])
+                self._prefill_fused([slot_idx], [req])
             return
         row_of = (lambda j: 0) if single else (lambda j: slots_idx[j])
         tokens = np.zeros((rows, s), np.int32)

@@ -95,3 +95,13 @@ class TieredBatcher:
         return sum(
             t.cache.k.nbytes + t.cache.v.nbytes for t in self.tiers
         )
+
+    # Prefix-pool counters aggregate across tiers (each tier owns its
+    # own pool — tiers share no mutable host state, docs/threading.md).
+    @property
+    def prefix_hits(self) -> int:
+        return sum(t.prefix_hits for t in self.tiers)
+
+    @property
+    def prefix_misses(self) -> int:
+        return sum(t.prefix_misses for t in self.tiers)

@@ -1,0 +1,176 @@
+"""Prefix (prompt-KV) cache tests: numerics through the pooled path
+must match the engine's uncached generate; counters, LRU eviction, and
+partial (LCP) reuse behave as documented (serving/batching.py).
+
+Reference analogue: none — the Go gateway proxied every call
+statelessly; prompt-KV reuse is a serving-plane capability of the new
+framework (system-prompt case)."""
+
+import asyncio
+
+import pytest
+
+from ggrmcp_tpu.core.config import (
+    BatchingConfig,
+    MeshConfig,
+    ServingConfig,
+)
+from ggrmcp_tpu.models import llama
+from ggrmcp_tpu.ops.sampling import SamplingConfig
+from ggrmcp_tpu.serving.batching import ContinuousBatcher
+from ggrmcp_tpu.serving.engine import GenerationEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return GenerationEngine(
+        llama.CONFIGS["tiny-llama"],
+        ServingConfig(
+            mesh=MeshConfig(tensor=2, data=0),
+            batching=BatchingConfig(max_batch_size=4, kv_cache_max_seq=256),
+        ),
+    )
+
+
+def batching_cfg(**kw) -> BatchingConfig:
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("kv_cache_max_seq", 256)
+    kw.setdefault("prefix_cache_entries", 2)
+    kw.setdefault("prefix_cache_min_seq", 8)
+    kw.setdefault("prefix_cache_max_seq", 64)
+    return BatchingConfig(**kw)
+
+
+async def collect(batcher, prompt, max_new, seed=0):
+    out: list[int] = []
+    reason = None
+    async for ids, r in batcher.submit(
+        prompt, max_new, SamplingConfig(temperature=0.0), seed=seed
+    ):
+        out.extend(ids)
+        reason = r
+    return out, reason
+
+
+def prompt_of(n: int, salt: int = 0) -> list[int]:
+    return [(i * 13 + salt * 71 + 5) % 500 + 1 for i in range(n)]
+
+
+class TestPrefixCache:
+    async def test_repeat_prompt_hits_and_matches(self, engine):
+        prompt = prompt_of(40)
+        expected, _ = engine.generate([prompt], max_new_tokens=6, seed=0)
+        batcher = ContinuousBatcher(engine, batching_cfg())
+        batcher.warmup()  # covers the pool + suffix-bucket warmup path
+        batcher.start()
+        try:
+            out1, _ = await collect(batcher, prompt, 6)
+            assert (batcher.prefix_hits, batcher.prefix_misses) == (0, 1)
+            out2, _ = await collect(batcher, prompt, 6)
+            assert batcher.prefix_hits == 1
+        finally:
+            await batcher.stop()
+        assert out1 == expected[0]
+        assert out2 == expected[0]
+
+    async def test_shared_prefix_partial_reuse(self, engine):
+        """Two prompts sharing a head then diverging: the second must
+        reuse the pooled KV up to the divergence (LCP), and its output
+        must equal the uncached engine path."""
+        head = prompt_of(24)
+        p1 = head + prompt_of(10, salt=1)
+        p2 = head + prompt_of(14, salt=2)
+        expected, _ = engine.generate([p1, p2], max_new_tokens=6, seed=0)
+        batcher = ContinuousBatcher(engine, batching_cfg())
+        batcher.start()
+        try:
+            out1, _ = await collect(batcher, p1, 6)
+            out2, _ = await collect(batcher, p2, 6)
+            # p1 pooled its 33-token prefix; p2 diverges at 24 → LCP hit.
+            assert batcher.prefix_hits == 1
+        finally:
+            await batcher.stop()
+        assert out1 == expected[0]
+        assert out2 == expected[1]
+
+    async def test_long_prompt_through_pool_matches(self, engine):
+        """Prefix pooling composes with chunked prefill (prompt longer
+        than prefill_chunk) and with max_seq-capped entries."""
+        prompt = prompt_of(80)
+        expected, _ = engine.generate([prompt], max_new_tokens=5, seed=0)
+        batcher = ContinuousBatcher(
+            engine, batching_cfg(prefill_chunk=16, prefix_cache_max_seq=32)
+        )
+        batcher.start()
+        try:
+            out1, _ = await collect(batcher, prompt, 5)
+            out2, _ = await collect(batcher, prompt, 5)
+            assert batcher.prefix_hits == 1
+        finally:
+            await batcher.stop()
+        assert out1 == expected[0]
+        assert out2 == expected[0]
+
+    async def test_lru_eviction_single_entry(self, engine):
+        a, b = prompt_of(20), prompt_of(20, salt=9)
+        batcher = ContinuousBatcher(
+            engine, batching_cfg(prefix_cache_entries=1)
+        )
+        batcher.start()
+        try:
+            await collect(batcher, a, 3)  # store a
+            await collect(batcher, b, 3)  # miss → evicts a
+            await collect(batcher, a, 3)  # miss again
+            assert (batcher.prefix_hits, batcher.prefix_misses) == (0, 3)
+        finally:
+            await batcher.stop()
+
+    async def test_longer_prefix_subsumes_shorter_entry(self, engine):
+        short = prompt_of(16)
+        longer = short + prompt_of(20, salt=3)
+        expected, _ = engine.generate([longer], max_new_tokens=4, seed=0)
+        batcher = ContinuousBatcher(engine, batching_cfg())
+        batcher.start()
+        try:
+            await collect(batcher, short, 3)  # pools short[:15]
+            out1, _ = await collect(batcher, longer, 4)  # hit + upgrade
+            assert batcher.prefix_hits == 1
+            stored = [k for k in batcher._pfx_keys if k is not None]
+            assert len(stored) == 1 and len(stored[0]) == len(longer) - 1
+            out2, _ = await collect(batcher, longer, 4)  # full-length hit
+            assert batcher.prefix_hits == 2
+        finally:
+            await batcher.stop()
+        assert out1 == expected[0]
+        assert out2 == expected[0]
+
+    async def test_pool_off_by_default(self, engine):
+        batcher = ContinuousBatcher(
+            engine, BatchingConfig(max_batch_size=4, kv_cache_max_seq=256)
+        )
+        assert batcher._pfx_pool is None
+        batcher.start()
+        try:
+            out, reason = await collect(batcher, prompt_of(20), 3)
+            assert reason in ("length", "stop")
+            assert (batcher.prefix_hits, batcher.prefix_misses) == (0, 0)
+        finally:
+            await batcher.stop()
+
+    async def test_concurrent_shared_prefix_burst(self, engine):
+        """A burst of requests sharing one system prompt: everything
+        still completes and matches greedy numerics per request."""
+        head = prompt_of(24)
+        prompts = [head + prompt_of(6, salt=s) for s in range(4)]
+        expected, _ = engine.generate(prompts, max_new_tokens=4, seed=0)
+        batcher = ContinuousBatcher(engine, batching_cfg())
+        batcher.start()
+        try:
+            outs = await asyncio.gather(
+                *(collect(batcher, p, 4) for p in prompts)
+            )
+        finally:
+            await batcher.stop()
+        for (out, reason), exp in zip(outs, expected):
+            assert reason in ("length", "stop")
+            assert out == exp
