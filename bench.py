@@ -180,6 +180,12 @@ async def _run_bench() -> dict:
             max_batch_size=min(32, max(8, sessions)),
             kv_cache_max_seq=512,
             decode_steps_per_tick=tick_steps,
+            # Exercised by the shared-system-prompt phase below; the
+            # main phase's prompts are shorter than min_seq, so its
+            # numbers are unaffected.
+            prefix_cache_entries=4,
+            prefix_cache_min_seq=48,
+            prefix_cache_max_seq=256,
         ),
     )
     sidecar = Sidecar(serving)
@@ -250,6 +256,68 @@ async def _run_bench() -> dict:
         bench_start = time.perf_counter()
         await asyncio.gather(*(session_worker(s) for s in range(sessions)))
         elapsed = time.perf_counter() - bench_start
+
+        # The headline measurement is complete: claim the output NOW so
+        # a watchdog firing during the secondary phases cannot discard
+        # it for a CPU fallback (same-owner re-claim below succeeds).
+        if not _claim_output():
+            raise RuntimeError("watchdog claimed output before run completed")
+
+        # Shared-system-prompt phase: every session prepends the same
+        # long preamble (the agentic deployment shape). One seeding
+        # call pools the prefix, then the concurrent wave reuses its
+        # KV; the in-process sidecar exposes the hit counters directly.
+        prefix = {}
+        try:
+            preamble = (
+                "You are the assistant for the Acme knowledge base. "
+                "Answer briefly, cite sources, refuse speculation. "
+            ) * 4
+            pfx_latencies: list[float] = []
+
+            async def prefix_call(i: int) -> None:
+                body = {
+                    "jsonrpc": "2.0", "method": "tools/call",
+                    "id": 90000 + i,
+                    "params": {
+                        "name": tool,
+                        "arguments": {
+                            "prompt": f"{preamble}Question {i}: what now?",
+                            "maxNewTokens": max_new,
+                        },
+                    },
+                }
+                t = time.perf_counter()
+                resp = await client.post("/", json=body)
+                data = await resp.json()
+                pfx_latencies.append(time.perf_counter() - t)
+                if "error" in data:
+                    raise RuntimeError(f"prefix call failed: {data['error']}")
+
+            await prefix_call(0)  # seeds the pool (trickle admission)
+            pfx_start = time.perf_counter()
+            n_pfx = 2 * sessions
+            # return_exceptions: let every sibling settle before leaving
+            # the phase — teardown must never race in-flight requests.
+            results = await asyncio.gather(
+                *(prefix_call(1 + i) for i in range(n_pfx)),
+                return_exceptions=True,
+            )
+            errs = [r for r in results if isinstance(r, BaseException)]
+            if errs:
+                raise errs[0]
+            pfx_elapsed = time.perf_counter() - pfx_start
+            batcher = sidecar.batcher
+            prefix = {
+                "prefix_calls_per_sec": round(n_pfx / pfx_elapsed, 2),
+                "prefix_p50_ms": round(
+                    statistics.median(pfx_latencies[1:]) * 1000, 1
+                ),
+                "prefix_hits": int(batcher.prefix_hits),
+                "prefix_misses": int(batcher.prefix_misses),
+            }
+        except Exception as exc:  # secondary phase must not sink the run
+            print(f"bench: prefix phase failed: {exc!r}", file=sys.stderr)
 
     # Device memory while the serving stack is live (KV cache + params
     # resident) — the VERDICT r1 #9 "measured HBM" extra.
@@ -328,6 +396,7 @@ async def _run_bench() -> dict:
         "warmup_s": round(warmup_s, 1),
         **hbm,
         **mfu,
+        **prefix,
         **proxy,
     }
 
