@@ -33,6 +33,22 @@ import time
 
 _OWNER_LOCK = threading.Lock()
 _OWNER = {"owner": None}
+# Set (under _OWNER_LOCK) to a complete result line as soon as the
+# headline measurement finishes; if the process wedges in a secondary
+# phase or teardown, the watchdog prints THIS instead of hanging
+# forever or discarding the finished measurement.
+_STASHED = {"line": None}
+_PRINTED = {"done": False}
+
+
+def _emit(line: str) -> None:
+    """Print the one result line exactly once across threads."""
+    with _OWNER_LOCK:
+        if _PRINTED["done"]:
+            return
+        _PRINTED["done"] = True
+    print(line)
+    sys.stdout.flush()
 
 
 # Peak dense bf16 FLOP/s per chip, keyed by jax device_kind — the MFU
@@ -257,9 +273,66 @@ async def _run_bench() -> dict:
         await asyncio.gather(*(session_worker(s) for s in range(sessions)))
         elapsed = time.perf_counter() - bench_start
 
-        # The headline measurement is complete: claim the output NOW so
-        # a watchdog firing during the secondary phases cannot discard
-        # it for a CPU fallback (same-owner re-claim below succeeds).
+        # The headline measurement is complete: build and STASH the
+        # result line, then claim the output — a watchdog firing during
+        # the secondary phases or teardown can neither discard the
+        # finished measurement for a CPU fallback nor hang the process
+        # with no output (it emits the stashed line and exits).
+        calls_per_sec = total / elapsed
+        p50 = statistics.median(latencies) * 1000
+        p99 = sorted(latencies)[int(len(latencies) * 0.99) - 1] * 1000
+        n_chips = len(devices) if on_tpu else 1
+        tokens_per_sec = calls_per_sec * max_new
+
+        # MFU: generated tokens/s × FLOPs/token ÷ aggregate chip peak.
+        # FLOPs/token ≈ 2 × params (dense decoder forward); decode
+        # tokens only, so prefill work makes true utilization slightly
+        # higher.
+        mfu = {}
+        try:
+            from ggrmcp_tpu.models import get_model
+            from ggrmcp_tpu.models import llama as llama_mod
+
+            family, mcfg = get_model(model)
+            peak = _CHIP_PEAK_FLOPS.get(devices[0].device_kind)
+            if family == "llama" and on_tpu and peak:
+                flops_per_token = 2.0 * llama_mod.num_params(mcfg)
+                mfu = {
+                    "model_params_million": round(
+                        llama_mod.num_params(mcfg) / 1e6, 1
+                    ),
+                    "flops_per_token": flops_per_token,
+                    "chip_peak_flops": peak,
+                    "mfu": round(
+                        tokens_per_sec * flops_per_token / (peak * n_chips), 6
+                    ),
+                }
+        except Exception as exc:  # diagnostics must not sink the result
+            print(f"bench: MFU computation failed: {exc!r}", file=sys.stderr)
+
+        base = {
+            "metric": "mcp_generate_calls_per_sec",
+            "value": round(calls_per_sec, 2),
+            "unit": "calls/s",
+            "vs_baseline": round(calls_per_sec / 1000.0, 4),
+            "p50_ms": round(p50, 1),
+            "p99_ms": round(p99, 1),
+            "platform": platform,
+            "device_kind": devices[0].device_kind,
+            "chips": n_chips,
+            "calls_per_sec_per_chip": round(calls_per_sec / n_chips, 2),
+            "model": model,
+            "quantize": quantize or "bf16",
+            "tokenizer": serving.tokenizer_path or "byte-level",
+            "sessions": sessions,
+            "total_calls": total,
+            "max_new_tokens": max_new,
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "warmup_s": round(warmup_s, 1),
+            **mfu,
+        }
+        with _OWNER_LOCK:
+            _STASHED["line"] = json.dumps(base)
         if not _claim_output():
             raise RuntimeError("watchdog claimed output before run completed")
 
@@ -334,71 +407,16 @@ async def _run_bench() -> dict:
     await gateway.stop()
     await sidecar.stop()
 
-    # The measurement is complete: claim the output NOW so a watchdog
-    # firing during the remaining teardown/proxy work cannot discard it.
+    # Same-owner re-claim (the stash/claim above already succeeded).
     if not _claim_output():
         raise RuntimeError("watchdog claimed output before run completed")
-
-    calls_per_sec = total / elapsed
-    p50 = statistics.median(latencies) * 1000
-    p99 = sorted(latencies)[int(len(latencies) * 0.99) - 1] * 1000
-    n_chips = len(devices) if on_tpu else 1
-    tokens_per_sec = calls_per_sec * max_new
-
-    # MFU: generated tokens/s × FLOPs/token ÷ aggregate chip peak.
-    # FLOPs/token ≈ 2 × params (dense decoder forward); decode tokens
-    # only, so prefill work makes the true utilization slightly higher.
-    mfu = {}
-    try:
-        from ggrmcp_tpu.models import get_model
-        from ggrmcp_tpu.models import llama as llama_mod
-
-        family, mcfg = get_model(model)
-        peak = _CHIP_PEAK_FLOPS.get(devices[0].device_kind)
-        if family == "llama" and on_tpu and peak:
-            flops_per_token = 2.0 * llama_mod.num_params(mcfg)
-            mfu = {
-                "model_params_million": round(
-                    llama_mod.num_params(mcfg) / 1e6, 1
-                ),
-                "flops_per_token": flops_per_token,
-                "chip_peak_flops": peak,
-                "mfu": round(
-                    tokens_per_sec * flops_per_token / (peak * n_chips), 6
-                ),
-            }
-    except Exception as exc:  # diagnostics must not sink the result
-        print(f"bench: MFU computation failed: {exc!r}", file=sys.stderr)
 
     try:
         proxy = await _proxy_bench()
     except Exception as exc:  # secondary metric must not sink the run
         print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
         proxy = {}
-    return {
-        "metric": "mcp_generate_calls_per_sec",
-        "value": round(calls_per_sec, 2),
-        "unit": "calls/s",
-        "vs_baseline": round(calls_per_sec / 1000.0, 4),
-        "p50_ms": round(p50, 1),
-        "p99_ms": round(p99, 1),
-        "platform": platform,
-        "device_kind": devices[0].device_kind,
-        "chips": n_chips,
-        "calls_per_sec_per_chip": round(calls_per_sec / n_chips, 2),
-        "model": model,
-        "quantize": quantize or "bf16",
-        "tokenizer": serving.tokenizer_path or "byte-level",
-        "sessions": sessions,
-        "total_calls": total,
-        "max_new_tokens": max_new,
-        "tokens_per_sec": round(tokens_per_sec, 1),
-        "warmup_s": round(warmup_s, 1),
-        **hbm,
-        **mfu,
-        **prefix,
-        **proxy,
-    }
+    return {**base, **hbm, **prefix, **proxy}
 
 
 async def _proxy_bench() -> dict:
@@ -516,14 +534,18 @@ def _cpu_fallback(reason: str) -> None:
             [sys.executable, os.path.abspath(__file__)],
             env=env, stdout=subprocess.PIPE, timeout=1200,
         )
-        sys.stdout.buffer.write(proc.stdout)
+        out = proc.stdout.decode(errors="replace").strip()
+        if not out:
+            raise RuntimeError(
+                f"cpu fallback produced no output (rc={proc.returncode})"
+            )
+        _emit(out)
     except Exception as exc:  # last resort: still one parseable line
-        print(json.dumps({
+        _emit(json.dumps({
             "metric": "mcp_generate_calls_per_sec", "value": 0.0,
             "unit": "calls/s", "vs_baseline": 0.0,
             "error": f"cpu fallback failed: {exc!r}",
         }))
-    sys.stdout.flush()
 
 
 def main() -> None:
@@ -545,7 +567,15 @@ def main() -> None:
         # during teardown/proxy cannot discard a finished TPU result.
         def _expired():
             if not _claim_output("watchdog"):
-                return  # main path already owns the output
+                # The main path finished measuring (it owns the output)
+                # but wedged in a secondary phase or teardown: emit its
+                # stashed headline line and exit — never hang with no
+                # result and never discard a finished TPU measurement.
+                with _OWNER_LOCK:
+                    line = _STASHED["line"]
+                if line:
+                    _emit(line)
+                os._exit(0)
             try:
                 _cpu_fallback(f"TPU run exceeded {budget_s:.0f}s budget")
             finally:
@@ -572,7 +602,7 @@ def main() -> None:
         return
     if not on_cpu and not _claim_output():
         return  # watchdog fired first and owns stdout
-    print(json.dumps(result))
+    _emit(json.dumps(result))
 
 
 if __name__ == "__main__":

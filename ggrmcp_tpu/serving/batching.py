@@ -600,6 +600,27 @@ class ContinuousBatcher:
         finally:
             request.cancelled = True
 
+    def cache_bytes(self) -> int:
+        """KV-cache HBM: the shared slot pool plus the prefix pool."""
+        total = self.cache.k.nbytes + self.cache.v.nbytes
+        if self._pfx_pool is not None:
+            total += self._pfx_pool.k.nbytes + self._pfx_pool.v.nbytes
+        return total
+
+    def stats(self) -> dict:
+        """Live counters for the ServingStats RPC / diagnostics. Reads
+        are loop-side snapshots of host state the executor mutates —
+        monotonic counters and slot flags, safe to read stale."""
+        return {
+            "active_slots": self._active_count(),
+            "total_slots": len(self.slots),
+            "queued_requests": self.pending.qsize(),
+            "kv_cache_bytes": self.cache_bytes(),
+            "prefix_cache_hits": self.prefix_hits,
+            "prefix_cache_misses": self.prefix_misses,
+            "decode_steps": self.step_counter,
+        }
+
     # -- the loop -----------------------------------------------------------
 
     def _free_slots(self) -> list[int]:

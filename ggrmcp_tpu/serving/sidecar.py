@@ -290,6 +290,20 @@ class Sidecar:
     # ModelInfoService
     # ------------------------------------------------------------------
 
+    async def get_serving_stats(self, request, context):
+        """Live batching/cache counters (serving_pb2.ServingStatsResponse);
+        zeros for an embed-only sidecar (no batcher). The kwargs
+        construction fails loudly if stats() keys drift from the proto."""
+        stats = dict(self.batcher.stats()) if self.batcher is not None else {}
+        if self.spec_batcher is not None:
+            stats["speculative_calls"] = self.spec_batcher.calls
+            stats["speculative_requests"] = self.spec_batcher.requests
+            stats["queued_requests"] = (
+                stats.get("queued_requests", 0)
+                + self.spec_batcher.queue.qsize()
+            )
+        return serving_pb2.ServingStatsResponse(**stats)
+
     async def get_model_info(self, request, context):
         engine = self.generation or self.embedding
         info = engine.model_info()
@@ -384,10 +398,18 @@ class Sidecar:
             )
         add_service(
             self.server, "ggrmcp.tpu.ModelInfoService",
-            {"GetModelInfo": MethodDef(
-                self.get_model_info,
-                serving_pb2.ModelInfoRequest, serving_pb2.ModelInfoResponse,
-            )},
+            {
+                "GetModelInfo": MethodDef(
+                    self.get_model_info,
+                    serving_pb2.ModelInfoRequest,
+                    serving_pb2.ModelInfoResponse,
+                ),
+                "GetServingStats": MethodDef(
+                    self.get_serving_stats,
+                    serving_pb2.ServingStatsRequest,
+                    serving_pb2.ServingStatsResponse,
+                ),
+            },
         )
         services.append("ggrmcp.tpu.DebugService")
         add_service(

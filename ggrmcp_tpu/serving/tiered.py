@@ -92,9 +92,15 @@ class TieredBatcher:
 
     def cache_bytes(self) -> int:
         """Total KV-cache HBM across tiers (bench/stats reporting)."""
-        return sum(
-            t.cache.k.nbytes + t.cache.v.nbytes for t in self.tiers
-        )
+        return sum(t.cache_bytes() for t in self.tiers)
+
+    def stats(self) -> dict:
+        """Aggregated ServingStats across tiers."""
+        per_tier = [t.stats() for t in self.tiers]
+        return {
+            key: sum(s[key] for s in per_tier)
+            for key in per_tier[0]
+        }
 
     # Prefix-pool counters aggregate across tiers (each tier owns its
     # own pool — tiers share no mutable host state, docs/threading.md).

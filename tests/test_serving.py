@@ -470,6 +470,26 @@ class TestSidecarGeneration:
             assert resp.num_devices == 8
             assert resp.platform == "cpu"
 
+    async def test_serving_stats(self):
+        async with sidecar_env() as (_, channel, _port):
+            gen = _unary(
+                channel, "/ggrmcp.tpu.GenerateService/Generate",
+                serving_pb2.GenerateRequest, serving_pb2.GenerateResponse,
+            )
+            await gen(serving_pb2.GenerateRequest(
+                prompt="count me", max_new_tokens=4
+            ))
+            stats_rpc = _unary(
+                channel, "/ggrmcp.tpu.ModelInfoService/GetServingStats",
+                serving_pb2.ServingStatsRequest,
+                serving_pb2.ServingStatsResponse,
+            )
+            stats = await stats_rpc(serving_pb2.ServingStatsRequest())
+            assert stats.total_slots >= 1
+            assert stats.kv_cache_bytes > 0
+            assert stats.decode_steps >= 1
+            assert stats.active_slots == 0  # request finished
+
     async def test_embed_not_registered_on_llama(self):
         # A generation sidecar does not even expose EmbedService —
         # family-scoped registration keeps pooled tool names collision-free.
