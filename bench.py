@@ -42,13 +42,15 @@ _PRINTED = {"done": False}
 
 
 def _emit(line: str) -> None:
-    """Print the one result line exactly once across threads."""
+    """Print the one result line exactly once across threads. The
+    print+flush happens INSIDE the lock so a watchdog os._exit after
+    its own (no-op) _emit can never truncate a line mid-write."""
     with _OWNER_LOCK:
         if _PRINTED["done"]:
             return
+        print(line)
+        sys.stdout.flush()
         _PRINTED["done"] = True
-    print(line)
-    sys.stdout.flush()
 
 
 # Peak dense bf16 FLOP/s per chip, keyed by jax device_kind — the MFU
@@ -310,7 +312,7 @@ async def _run_bench() -> dict:
         except Exception as exc:  # diagnostics must not sink the result
             print(f"bench: MFU computation failed: {exc!r}", file=sys.stderr)
 
-        base = {
+        headline = {
             "metric": "mcp_generate_calls_per_sec",
             "value": round(calls_per_sec, 2),
             "unit": "calls/s",
@@ -332,7 +334,7 @@ async def _run_bench() -> dict:
             **mfu,
         }
         with _OWNER_LOCK:
-            _STASHED["line"] = json.dumps(base)
+            _STASHED["line"] = json.dumps(headline)
         if not _claim_output():
             raise RuntimeError("watchdog claimed output before run completed")
 
@@ -416,7 +418,7 @@ async def _run_bench() -> dict:
     except Exception as exc:  # secondary metric must not sink the run
         print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
         proxy = {}
-    return {**base, **hbm, **prefix, **proxy}
+    return {**headline, **hbm, **prefix, **proxy}
 
 
 async def _proxy_bench() -> dict:
@@ -567,15 +569,19 @@ def main() -> None:
         # during teardown/proxy cannot discard a finished TPU result.
         def _expired():
             if not _claim_output("watchdog"):
-                # The main path finished measuring (it owns the output)
-                # but wedged in a secondary phase or teardown: emit its
-                # stashed headline line and exit — never hang with no
-                # result and never discard a finished TPU measurement.
                 with _OWNER_LOCK:
                     line = _STASHED["line"]
                 if line:
+                    # The main path finished measuring (stash set) but
+                    # wedged in a secondary phase or teardown: emit its
+                    # headline line and exit — never hang with no
+                    # result, never discard a finished measurement.
                     _emit(line)
-                os._exit(0)
+                    os._exit(0)
+                # Main owns the output but hasn't stashed: it is mid
+                # CPU-fallback (probe failure / run error) and will
+                # print its own line — let it finish.
+                return
             try:
                 _cpu_fallback(f"TPU run exceeded {budget_s:.0f}s budget")
             finally:

@@ -397,6 +397,9 @@ class MCPHandler:
         (handler.go:367-376)."""
         stats = self.discoverer.get_service_stats()
         stats["sessions"] = self.sessions.stats()
+        serving = await self.discoverer.get_backend_serving_stats()
+        if serving:
+            stats["serving"] = serving
         return web.json_response(stats)
 
     async def handle_traces(self, request: web.Request) -> web.Response:

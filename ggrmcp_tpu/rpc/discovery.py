@@ -365,6 +365,38 @@ class ServiceDiscoverer:
 
     # -- health / stats -----------------------------------------------------
 
+    SERVING_STATS_METHOD = "ggrmcp.tpu.ModelInfoService.GetServingStats"
+
+    async def get_backend_serving_stats(
+        self, timeout_s: float = 2.0
+    ) -> list[dict[str, Any]]:
+        """Best-effort ServingStats from every healthy backend exposing
+        the model plane's stats RPC (TPU sidecars; other backends just
+        don't have the method). Fans out concurrently; a slow or failed
+        backend contributes an error entry, never an exception."""
+
+        async def call(backend: Backend, mi) -> dict[str, Any]:
+            try:
+                out = await backend.invoker.invoke(mi, {}, None, timeout_s)
+                return {"target": backend.target, **out}
+            except Exception as exc:  # noqa: BLE001 — diagnostics only
+                return {"target": backend.target, "error": str(exc)}
+
+        jobs = []
+        for backend in self.backends:
+            if not backend.healthy or backend.invoker is None:
+                continue
+            mi = next(
+                (
+                    m for m in backend.methods
+                    if m.full_name == self.SERVING_STATS_METHOD
+                ),
+                None,
+            )
+            if mi is not None:
+                jobs.append(call(backend, mi))
+        return list(await asyncio.gather(*jobs)) if jobs else []
+
     async def health_check(self) -> bool:
         """Healthy iff at least one backend passes its deep check."""
         if not self.backends:

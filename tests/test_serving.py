@@ -628,6 +628,16 @@ class TestGatewayToSidecar:
                 payload = json.loads(data["result"]["content"][0]["text"])
                 assert payload["modelId"] == "tiny-llama"
                 assert payload["completionTokens"] <= 5
+
+                # /stats surfaces the model plane's live counters
+                # (ServingStats fan-out to every sidecar backend).
+                resp = await client.get("/stats")
+                stats = await resp.json()
+                serving = stats["serving"]
+                assert len(serving) == 1
+                assert serving[0]["target"] == f"localhost:{port}"
+                assert int(serving[0]["totalSlots"]) >= 1
+                assert int(serving[0]["kvCacheBytes"]) > 0
         finally:
             await gw.stop()
             await side.stop()
