@@ -389,6 +389,9 @@ class MCPHandler:
         stats = self.discoverer.get_service_stats()
         healthy_backends = sum(1 for b in stats["backends"] if b["healthy"])
         self.metrics.set_gauges(self.sessions.count(), healthy_backends)
+        self.metrics.set_serving_stats(
+            await self.discoverer.get_backend_serving_stats()
+        )
         payload, content_type = self.metrics.render()
         return web.Response(body=payload, content_type=content_type.split(";")[0])
 

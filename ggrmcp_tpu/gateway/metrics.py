@@ -34,6 +34,11 @@ _LATENCY_BUCKETS = (
 )
 
 
+def _snake_to_camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(part.title() for part in rest)
+
+
 class GatewayMetrics:
     """All gateway-side instruments, on a private registry."""
 
@@ -90,10 +95,34 @@ class GatewayMetrics:
             ["scope"],  # global | session
             registry=self.registry,
         )
+        # Model-plane gauges, scraped from each TPU sidecar backend's
+        # ServingStats RPC at /metrics time (zeros until first scrape;
+        # absent for backends without the RPC).
+        self.serving_gauges = {
+            name: Gauge(
+                f"gateway_backend_{name}",
+                f"Backend ServingStats: {help_}",
+                ["target"],
+                registry=self.registry,
+            )
+            for name, help_ in [
+                ("active_slots", "decode slots generating"),
+                ("total_slots", "decode slot pool size"),
+                ("queued_requests", "requests waiting for a slot"),
+                ("kv_cache_bytes", "KV-cache HBM bytes"),
+                ("prefix_cache_hits", "prefix cache hits"),
+                ("prefix_cache_misses", "prefix cache misses"),
+                ("decode_steps", "fused decode steps issued"),
+                ("speculative_calls", "speculative device calls"),
+                ("speculative_requests", "requests served speculatively"),
+            ]
+        }
         # labels() re-validates and re-hashes label values every call
         # (~6 µs each, ×5 per request); label children are cached here.
         # Cardinality is bounded by tool/method/status counts.
         self._children: dict[tuple, object] = {}
+        # Targets currently exporting serving gauges (for stale removal).
+        self._serving_targets: set[str] = set()
 
     # -- recording helpers (no-ops without prometheus) ----------------------
 
@@ -132,6 +161,34 @@ class GatewayMetrics:
             return
         self.sessions_active.set(sessions)
         self.backends_healthy.set(healthy_backends)
+
+    def set_serving_stats(self, per_backend: list[dict]) -> None:
+        """Record ServingStats entries (from
+        ServiceDiscoverer.get_backend_serving_stats: camelCase protojson
+        keys plus 'target'). Every gauge is set unconditionally —
+        protojson omits zero-valued proto3 scalars, and a skipped set
+        would freeze a drained counter at its last busy reading. Targets
+        that disappeared or now error are removed entirely so a dead
+        backend never keeps exporting stale values."""
+        if self.registry is None:
+            return
+        live: set[str] = set()
+        for entry in per_backend:
+            target = entry.get("target", "unknown")
+            if "error" in entry:
+                continue
+            live.add(target)
+            for name, gauge in self.serving_gauges.items():
+                value = entry.get(_snake_to_camel(name), 0)
+                self._child(gauge, target).set(int(value))
+        for target in self._serving_targets - live:
+            for gauge in self.serving_gauges.values():
+                try:
+                    gauge.remove(target)
+                except KeyError:
+                    pass
+                self._children.pop((id(gauge), target), None)
+        self._serving_targets = live
 
     def render(self) -> tuple[bytes, str]:
         """Prometheus text exposition."""

@@ -80,10 +80,10 @@ SERVICE_NAMES = [
 class InProcessBackend:
     """Owns one in-process server; use as an async context manager."""
 
-    def __init__(self, with_reflection: bool = True):
+    def __init__(self, with_reflection: bool = True, port: int = 0):
         self.server = grpc.aio.server()
         self.health = HealthService()
-        self.port = 0
+        self.port = port  # 0 = ephemeral; fixed port for restart tests
         self.with_reflection = with_reflection
 
     @property
@@ -140,7 +140,10 @@ class InProcessBackend:
         if self.with_reflection:
             ReflectionService(SERVICE_NAMES).attach(self.server)
         self.health.attach(self.server)
-        self.port = self.server.add_insecure_port("localhost:0")
+        requested = self.port
+        self.port = self.server.add_insecure_port(f"localhost:{requested}")
+        assert self.port != 0, f"bind failed for localhost:{requested}"
+        assert requested in (0, self.port)
         await self.server.start()
         return self
 

@@ -1,0 +1,81 @@
+"""Failure-detection / elastic-recovery end-to-end (SURVEY.md §5.3):
+the reference's `Reconnect` was dead code — a failed upstream yielded
+per-call errors until process restart. Here the background watchdog
+must notice a dead backend, evict it from routing, and re-admit it
+after it comes back on the same target WITHOUT restarting the gateway.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+
+from ggrmcp_tpu.core import config as cfgmod
+from ggrmcp_tpu.gateway.app import Gateway
+from tests.backend_utils import InProcessBackend
+
+
+async def call_hello(client, id_=1):
+    resp = await client.post("/", json={
+        "jsonrpc": "2.0", "method": "tools/call", "id": id_,
+        "params": {
+            "name": "hello_helloservice_sayhello",
+            "arguments": {"name": "probe"},
+        },
+    })
+    return await resp.json()
+
+
+class TestBackendRestartRecovery:
+    async def test_kill_restart_same_port_recovers(self):
+        backend = await InProcessBackend().__aenter__()
+        port = backend.port
+
+        cfg = cfgmod.default()
+        cfg.server.host = "127.0.0.1"
+        cfg.server.port = 0
+        cfg.grpc.reconnect.enabled = True
+        cfg.grpc.reconnect.watchdog_interval_s = 0.3
+        cfg.grpc.reconnect.interval_s = 0.1
+        cfg.grpc.reconnect.max_attempts = 2
+        cfg.grpc.connect_timeout_s = 2.0
+        gw = Gateway(cfg, targets=[f"localhost:{port}"])
+        await gw.start()
+        restarted = None
+        try:
+            async with aiohttp.ClientSession(
+                base_url=f"http://127.0.0.1:{gw.port}"
+            ) as client:
+                data = await call_hello(client, 1)
+                assert "error" not in data
+                payload = json.loads(data["result"]["content"][0]["text"])
+                assert payload["message"] == "Hello, probe!"
+
+                # Kill the upstream: calls fail as isError tool results
+                # (handler.go:252-259 semantics), never protocol errors.
+                await backend.server.stop(grace=None)
+                data = await call_hello(client, 2)
+                assert data["result"]["isError"] is True
+
+                # Same target comes back; the watchdog must reconnect
+                # and rediscover with no gateway restart.
+                restarted = await InProcessBackend(port=port).__aenter__()
+                deadline = asyncio.get_event_loop().time() + 30.0
+                data = None
+                while asyncio.get_event_loop().time() < deadline:
+                    data = await call_hello(client, 3)
+                    if "result" in data and not data["result"].get("isError"):
+                        break
+                    await asyncio.sleep(0.3)
+                assert data is not None and "result" in data, data
+                assert not data["result"].get("isError"), data
+                payload = json.loads(data["result"]["content"][0]["text"])
+                assert payload["message"] == "Hello, probe!"
+
+                # /health reflects the recovery too.
+                resp = await client.get("/health")
+                assert resp.status == 200
+        finally:
+            await gw.stop()
+            if restarted is not None:
+                await restarted.__aexit__()

@@ -638,6 +638,12 @@ class TestGatewayToSidecar:
                 assert serving[0]["target"] == f"localhost:{port}"
                 assert int(serving[0]["totalSlots"]) >= 1
                 assert int(serving[0]["kvCacheBytes"]) > 0
+
+                # ...and /metrics exports them as per-target gauges.
+                resp = await client.get("/metrics")
+                text = await resp.text()
+                assert "gateway_backend_kv_cache_bytes{" in text
+                assert f'target="localhost:{port}"' in text
         finally:
             await gw.stop()
             await side.stop()
