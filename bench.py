@@ -18,6 +18,8 @@ Environment knobs:
   GGRMCP_BENCH_QUANT     serving weight quantization: "" (bf16, default)
                          or "int8" (halves weight-streaming HBM traffic,
                          the decode bottleneck at small batch)
+  GGRMCP_BENCH_KV        KV-cache storage: "" (model dtype, default) or
+                         "int8" (halves KV HBM + decode KV bandwidth)
   GGRMCP_BENCH_CPU=1     force the CPU platform (tiny model)
 """
 
@@ -190,9 +192,11 @@ async def _run_bench() -> dict:
         os.environ.get("GGRMCP_BENCH_TICK_STEPS", "8" if on_tpu else "1")
     )
     quantize = os.environ.get("GGRMCP_BENCH_QUANT", "")
+    kv_dtype = os.environ.get("GGRMCP_BENCH_KV", "")
     serving = ServingConfig(
         model=model,
         quantize=quantize,
+        kv_cache_dtype=kv_dtype,
         mesh=MeshConfig(tensor=0),  # all local devices on the tensor axis
         batching=BatchingConfig(
             max_batch_size=min(32, max(8, sessions)),
@@ -325,6 +329,7 @@ async def _run_bench() -> dict:
             "calls_per_sec_per_chip": round(calls_per_sec / n_chips, 2),
             "model": model,
             "quantize": quantize or "bf16",
+            "kv_cache_dtype": kv_dtype or "model-dtype",
             "tokenizer": serving.tokenizer_path or "byte-level",
             "sessions": sessions,
             "total_calls": total,
@@ -553,11 +558,12 @@ def _cpu_fallback(reason: str) -> None:
 def main() -> None:
     from ggrmcp_tpu.core.config import QUANTIZE_MODES
 
-    if os.environ.get("GGRMCP_BENCH_QUANT", "") not in QUANTIZE_MODES:
-        raise SystemExit(
-            f"GGRMCP_BENCH_QUANT must be one of {QUANTIZE_MODES}, "
-            f"got {os.environ['GGRMCP_BENCH_QUANT']!r}"
-        )
+    for knob in ("GGRMCP_BENCH_QUANT", "GGRMCP_BENCH_KV"):
+        if os.environ.get(knob, "") not in QUANTIZE_MODES:
+            raise SystemExit(
+                f"{knob} must be one of {QUANTIZE_MODES}, "
+                f"got {os.environ[knob]!r}"
+            )
     budget_s = float(os.environ.get("GGRMCP_BENCH_BUDGET_S", "1500"))
     on_cpu = os.environ.get("GGRMCP_BENCH_CPU") == "1"
     if not on_cpu:

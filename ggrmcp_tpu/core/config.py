@@ -342,6 +342,11 @@ class ServingConfig:
     # Weight quantization for decoder serving: "" (off) or "int8"
     # (per-channel weight-only — halves HBM traffic on decode).
     quantize: str = ""
+    # KV-cache storage: "" (model dtype) or "int8" (per-position/head
+    # scales — halves KV HBM and the per-step KV bandwidth, doubling
+    # context/slot headroom; decode attention takes the XLA path so
+    # the cast+scale fuse into the matmuls). Composes with `quantize`.
+    kv_cache_dtype: str = ""
     # Speculative decoding (greedy/lossless): registry key of a small
     # dense draft model sharing the target's vocab ("" → off). Unary
     # greedy Generate calls then verify `speculative_gamma` drafted
@@ -489,6 +494,17 @@ class Config:
             raise ValueError(
                 f"unknown serving.quantize {self.serving.quantize!r}; "
                 f"supported: 'int8'"
+            )
+        if self.serving.kv_cache_dtype not in QUANTIZE_MODES:
+            raise ValueError(
+                f"unknown serving.kv_cache_dtype "
+                f"{self.serving.kv_cache_dtype!r}; supported: 'int8'"
+            )
+        if self.serving.kv_cache_dtype and self.serving.mesh.stage > 1:
+            raise ValueError(
+                "kv_cache_dtype='int8' is not supported under "
+                "pipeline-parallel serving (the staged forward manages "
+                "its own cache layout)"
             )
 
 

@@ -30,7 +30,12 @@ from jax.sharding import PartitionSpec as P
 
 from ggrmcp_tpu.models import common
 from ggrmcp_tpu.ops.attention import attention
-from ggrmcp_tpu.ops.quant import QuantizedArray, embed_lookup
+from ggrmcp_tpu.ops.quant import (
+    QuantizedArray,
+    dequantize,
+    embed_lookup,
+    quantize,
+)
 from ggrmcp_tpu.ops.quant import matmul as qmatmul
 from ggrmcp_tpu.ops.rope import apply_rope
 
@@ -147,9 +152,26 @@ class KVCache(NamedTuple):
     length: jnp.ndarray  # [B] int32 — valid prefix length
 
     @classmethod
-    def create(cls, cfg: LlamaConfig, batch: int, max_len: int) -> "KVCache":
+    def create(
+        cls, cfg: LlamaConfig, batch: int, max_len: int, kv_dtype: str = ""
+    ) -> "KVCache":
+        """kv_dtype "" = model dtype; "int8" = quantized KV (values
+        int8, per-position/head scales in the model dtype — halves KV
+        HBM and decode KV bandwidth; serving.kv_cache_dtype)."""
         shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
         dtype = cfg.jnp_dtype
+        if kv_dtype == "int8":
+            def leaf():
+                return QuantizedArray(
+                    q=jnp.zeros(shape, jnp.int8),
+                    scale=jnp.zeros(shape[:-1] + (1,), dtype),
+                )
+            return cls(
+                k=leaf(), v=leaf(),
+                length=jnp.zeros((batch,), jnp.int32),
+            )
+        if kv_dtype:
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
         return cls(
             k=jnp.zeros(shape, dtype),
             v=jnp.zeros(shape, dtype),
@@ -213,9 +235,35 @@ def attention_block(
         # indexing with explicit batch indices (compiles to scatter).
         batch_idx = jnp.arange(b)[:, None]  # [B, 1]
         write_pos = cache_len[:, None] + jnp.arange(s)[None, :]  # [B, S]
-        cache_k = cache_k.at[batch_idx, write_pos].set(k)
-        cache_v = cache_v.at[batch_idx, write_pos].set(v)
-        k_all, v_all = cache_k, cache_v
+        if isinstance(cache_k, QuantizedArray):
+            # Int8 KV: quantize the step's K/V per position+head and
+            # scatter values + scales. Reads dequantize lazily — XLA
+            # fuses the s8→bf16 cast and the scale multiply into the
+            # attention matmuls, so HBM traffic stays int8 (the whole
+            # point: decode streams the cache every step). The current
+            # step's K/V also round-trip through int8, keeping prefill
+            # and decode numerics consistent.
+            qk = quantize(k, axis=-1)
+            qv = quantize(v, axis=-1)
+            cache_k = QuantizedArray(
+                q=cache_k.q.at[batch_idx, write_pos].set(qk.q),
+                scale=cache_k.scale.at[batch_idx, write_pos].set(
+                    qk.scale.astype(cache_k.scale.dtype)
+                ),
+            )
+            cache_v = QuantizedArray(
+                q=cache_v.q.at[batch_idx, write_pos].set(qv.q),
+                scale=cache_v.scale.at[batch_idx, write_pos].set(
+                    qv.scale.astype(cache_v.scale.dtype)
+                ),
+            )
+            k_all, v_all = dequantize(cache_k), dequantize(cache_v)
+            use_flash = False  # materializing bf16 KV for the Pallas
+            # kernel would forfeit the int8 bandwidth win
+        else:
+            cache_k = cache_k.at[batch_idx, write_pos].set(k)
+            cache_v = cache_v.at[batch_idx, write_pos].set(v)
+            k_all, v_all = cache_k, cache_v
         kv_len = cache_len + s
         q_offset = cache_len
     else:

@@ -68,6 +68,20 @@ def dequantize(qa: QuantizedArray) -> jnp.ndarray:
     return qa.q.astype(qa.scale.dtype) * qa.scale
 
 
+def kv_map(fn, *kvs: ArrayOrQuant) -> ArrayOrQuant:
+    """Apply a positional array op to possibly-quantized KV buffers.
+    An int8 KV cache stores values [.., S, KVH, D] and scales
+    [.., S, KVH, 1]; every cache bookkeeping op (row scatter/merge,
+    slot select, prefix slice) indexes leading axes only, so it applies
+    to q and scale identically. Plain arrays pass through to `fn`."""
+    if isinstance(kvs[0], QuantizedArray):
+        return QuantizedArray(
+            q=fn(*(x.q for x in kvs)),
+            scale=fn(*(x.scale for x in kvs)),
+        )
+    return fn(*kvs)
+
+
 def matmul(x: jnp.ndarray, w: ArrayOrQuant) -> jnp.ndarray:
     """`x @ w` for dense or quantized weights. For QuantizedArray the
     int8 weight is cast to the activation dtype in-register and the

@@ -49,6 +49,7 @@ def speculative_generate(
     max_new=None,  # traced per-call cap ≤ max_new_budget (None → budget)
     use_flash=None,  # threaded to forward (see engine flash policy)
     flash_mesh=None,
+    kv_dtype: str = "",  # "" model dtype | "int8" quantized KV caches
 ) -> SpecResult:
     """Generate up to `max_new` tokens per row, greedy, speculative.
 
@@ -68,8 +69,11 @@ def speculative_generate(
         max_new = max_new_budget
     max_new = jnp.minimum(jnp.int32(max_new), max_new_budget)
     budget = s + max_new_budget + gamma + 2  # verify may overshoot
-    tcache = _kv_class(target_fam).create(target_cfg, b, budget)
-    dcache = _kv_class(draft_fam).create(draft_cfg, b, budget)
+    # Per-position int8 quantization is write-order independent, so
+    # the verify re-reads see exactly the cache the draft rounds wrote
+    # and the lossless guarantee holds within the int8 config.
+    tcache = _kv_class(target_fam).create(target_cfg, b, budget, kv_dtype)
+    dcache = _kv_class(draft_fam).create(draft_cfg, b, budget, kv_dtype)
 
     # Prefill both models on the prompt.
     tlogits, tcache = target_fam.forward(

@@ -27,6 +27,7 @@ import numpy as np
 
 from ggrmcp_tpu.core.config import BatchingConfig
 from ggrmcp_tpu.models import llama as llama_mod
+from ggrmcp_tpu.ops import quant
 from ggrmcp_tpu.ops.sampling import SamplingConfig, sample_dynamic
 from ggrmcp_tpu.serving.engine import bucket_len, fit_request
 
@@ -36,15 +37,19 @@ logger = logging.getLogger("ggrmcp.serving.batching")
 def _merge_row(cache, mini, slot, length):
     """Merge a single prefilled row's [1, S] K/V block into the shared
     [B, S_max] cache at `slot` and set that row's length. The one
-    cache-merge definition shared by fused and chunked admission."""
-    k = jax.lax.dynamic_update_slice(
-        cache.k, mini.k.astype(cache.k.dtype), (0, slot, 0, 0, 0)
-    )
-    v = jax.lax.dynamic_update_slice(
-        cache.v, mini.v.astype(cache.v.dtype), (0, slot, 0, 0, 0)
-    )
+    cache-merge definition shared by fused and chunked admission.
+    kv_map keeps it working for int8 KV (values + scales merge
+    identically; both index leading axes only)."""
+
+    def merge(c, m):
+        return jax.lax.dynamic_update_slice(
+            c, m.astype(c.dtype), (0, slot, 0, 0, 0)
+        )
+
     return llama_mod.KVCache(
-        k=k, v=v, length=cache.length.at[slot].set(length)
+        k=quant.kv_map(merge, cache.k, mini.k),
+        v=quant.kv_map(merge, cache.v, mini.v),
+        length=cache.length.at[slot].set(length),
     )
 
 
@@ -169,7 +174,9 @@ class ContinuousBatcher:
         [R, S] against a fresh mini cache, sample each row's first
         token. Returns (first [R], mini cache)."""
         r, s = tokens.shape
-        mini = llama_mod.KVCache.create(self.engine.cfg, r, s)
+        mini = llama_mod.KVCache.create(
+            self.engine.cfg, r, s, self.engine.kv_dtype
+        )
         # Fresh prefill → engine.prefill_forward (handles MoE validity
         # and the sequence-parallel long-chunk path).
         valid = jnp.arange(s)[None, :] < true_len[:, None]
@@ -202,12 +209,14 @@ class ContinuousBatcher:
             params, tokens, true_len, seeds, temps, ks, ps
         )
         sel = valid[None, :, None, None, None]
-        k = cache.k.at[:, :, :s].set(
-            jnp.where(sel, mini.k.astype(cache.k.dtype), cache.k[:, :, :s])
-        )
-        v = cache.v.at[:, :, :s].set(
-            jnp.where(sel, mini.v.astype(cache.v.dtype), cache.v[:, :, :s])
-        )
+
+        def select(c, m):
+            return c.at[:, :, :s].set(
+                jnp.where(sel, m.astype(c.dtype), c[:, :, :s])
+            )
+
+        k = quant.kv_map(select, cache.k, mini.k)
+        v = quant.kv_map(select, cache.v, mini.v)
         lengths = jnp.where(valid, true_len, cache.length)
         return first, llama_mod.KVCache(k=k, v=v, length=lengths)
 
@@ -260,8 +269,11 @@ class ContinuousBatcher:
         prefilled mini row into pool entry `entry` (the same row-merge
         as slot insertion, with the mini clipped to the pool width)."""
         m = self._pfx_max
+        clip = lambda a: a[:, :, :m]  # noqa: E731 — leading-axis slice
         clipped = llama_mod.KVCache(
-            k=mini.k[:, :, :m], v=mini.v[:, :, :m], length=mini.length
+            k=quant.kv_map(clip, mini.k),
+            v=quant.kv_map(clip, mini.v),
+            length=mini.length,
         )
         return _merge_row(pool, clipped, entry, plen)
 
@@ -271,16 +283,17 @@ class ContinuousBatcher:
         extends from position `plen` exactly as if the prefix had just
         been prefilled. Stale pool positions past `plen` are overwritten
         by the suffix chunks or masked by the final length."""
-        pk = jax.lax.dynamic_slice_in_dim(pool.k, entry, 1, axis=1)
-        pv = jax.lax.dynamic_slice_in_dim(pool.v, entry, 1, axis=1)
-        k = jax.lax.dynamic_update_slice(
-            mini.k, pk.astype(mini.k.dtype), (0, 0, 0, 0, 0)
-        )
-        v = jax.lax.dynamic_update_slice(
-            mini.v, pv.astype(mini.v.dtype), (0, 0, 0, 0, 0)
-        )
+
+        def load(m, p):
+            row = jax.lax.dynamic_slice_in_dim(p, entry, 1, axis=1)
+            return jax.lax.dynamic_update_slice(
+                m, row.astype(m.dtype), (0, 0, 0, 0, 0)
+            )
+
         return llama_mod.KVCache(
-            k=k, v=v, length=jnp.full((1,), plen, jnp.int32)
+            k=quant.kv_map(load, mini.k, pool.k),
+            v=quant.kv_map(load, mini.v, pool.v),
+            length=jnp.full((1,), plen, jnp.int32),
         )
 
     # -- prefix-pool host side (executor-serialized, batcher-owned) ---------
@@ -402,7 +415,9 @@ class ContinuousBatcher:
         prompt = request.prompt
         n = len(prompt)
         c = min(self.cfg.prefill_chunk, self.max_seq)
-        mini = llama_mod.KVCache.create(self.engine.cfg, 1, self.max_seq)
+        mini = llama_mod.KVCache.create(
+            self.engine.cfg, 1, self.max_seq, self.engine.kv_dtype
+        )
         start = 0
         if pfx is not None:
             # Lookup already rejected geometrically unusable matches,
@@ -517,7 +532,9 @@ class ContinuousBatcher:
         # prefix pool routes short prompts through it).
         if self.cfg.prefill_chunk < self.max_seq or self._pfx_pool is not None:
             c = min(self.cfg.prefill_chunk, self.max_seq)
-            mini = llama_mod.KVCache.create(self.engine.cfg, 1, self.max_seq)
+            mini = llama_mod.KVCache.create(
+                self.engine.cfg, 1, self.max_seq, self.engine.kv_dtype
+            )
             logits, mini = self._chunk_step(
                 self.engine.params, jnp.asarray(np.zeros((1, c), np.int32)),
                 mini, jnp.asarray(zlen1),

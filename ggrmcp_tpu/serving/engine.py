@@ -35,6 +35,7 @@ from ggrmcp_tpu.models import bert as bert_mod
 from ggrmcp_tpu.models import llama as llama_mod
 from ggrmcp_tpu.models import moe as moe_mod
 from ggrmcp_tpu.models.common import count_params
+from ggrmcp_tpu.ops import quant
 from ggrmcp_tpu.ops.sampling import SamplingConfig, sample
 from ggrmcp_tpu.parallel import mesh as mesh_mod
 from ggrmcp_tpu.utils.jaxenv import apply_platform_env
@@ -135,8 +136,19 @@ class GenerationEngine:
             )
             self.flash_mesh = self.mesh if shardable else None
             self.use_flash = None if shardable else False
+        self.kv_dtype = getattr(self.serving, "kv_cache_dtype", "")
+        if self.kv_dtype:
+            # Materializing a bf16 cache for the Pallas kernel would
+            # forfeit the int8 bandwidth win — the XLA path fuses the
+            # cast+scale into the attention matmuls instead.
+            self.use_flash, self.flash_mesh = False, None
         self._init_sp_prefill()
         self._init_pp_serving()
+        if self.pp_serving and self.kv_dtype:
+            raise ValueError(
+                "kv_cache_dtype='int8' is not supported under "
+                "pipeline-parallel serving"
+            )
         param_specs = (
             self._pp.param_specs_pp(cfg) if self.pp_serving
             else self.fam.param_specs(cfg)
@@ -182,6 +194,12 @@ class GenerationEngine:
 
         self._sp_n = mesh_mod.axis_size(self.mesh, "sequence")
         mode = self.serving.sp_prefill
+        if mode and self.kv_dtype:
+            # The sp path attends with raw bf16 K/V while the cache
+            # stores int8 — the same prompt would decode differently
+            # through sp vs XLA prefill. Keep numerics path-independent.
+            logger.warning("sp_prefill disabled with kv_cache_dtype=int8")
+            mode = ""
         self.sp_prefill = mode if (self._sp_n > 1 and mode) else ""
         self.sp_min_seq = self.serving.sp_prefill_min_seq
         if not self.sp_prefill:
@@ -334,6 +352,7 @@ class GenerationEngine:
             tokens, true_len, max_new_budget,
             self.serving.speculative_gamma, eos_id, max_new=max_new,
             use_flash=self.use_flash, flash_mesh=self.flash_mesh,
+            kv_dtype=self.kv_dtype,
         )
 
     def warmup_speculative(self, max_new_budget: int = 64) -> None:
@@ -419,7 +438,7 @@ class GenerationEngine:
         out_len [B])."""
         b = tokens.shape[0]
         max_cache = tokens.shape[1] + max_new
-        cache = llama_mod.KVCache.create(self.cfg, b, max_cache)
+        cache = llama_mod.KVCache.create(self.cfg, b, max_cache, self.kv_dtype)
         last_logits, cache = self._prefill_impl(tokens, true_len, cache)
         key0 = jax.random.fold_in(rng, 0)
         first = sample(last_logits, key0, sampling)  # [B]
@@ -458,14 +477,31 @@ class GenerationEngine:
             self._pp.cache_specs_pp() if self.pp_serving
             else self.fam.cache_specs()
         )
+        scale_shape = kv_shape[:-1] + (1,)
+
+        def kv_spec(spec):
+            adapted = mesh_mod.compatible_spec(spec, kv_shape, self.mesh)
+            if not self.kv_dtype:
+                return adapted
+            # Quantized leaf: the scale tree mirrors the values
+            # (quantize_specs pattern); its size-1 last axis drops any
+            # non-dividing spec entry via compatible_spec.
+            return quant.QuantizedArray(
+                q=adapted,
+                scale=mesh_mod.compatible_spec(spec, scale_shape, self.mesh),
+            )
+
         specs = llama_mod.KVCache(
-            k=mesh_mod.compatible_spec(specs.k, kv_shape, self.mesh),
-            v=mesh_mod.compatible_spec(specs.v, kv_shape, self.mesh),
+            k=kv_spec(specs.k),
+            v=kv_spec(specs.v),
             length=mesh_mod.compatible_spec(specs.length, (batch,), self.mesh),
         )
         with self.mesh:
             return jax.jit(
-                partial(llama_mod.KVCache.create, self.cfg, batch, max_len),
+                partial(
+                    llama_mod.KVCache.create, self.cfg, batch, max_len,
+                    self.kv_dtype,
+                ),
                 out_shardings=jax.tree_util.tree_map(
                     lambda s: NamedSharding(self.mesh, s), specs,
                 ),

@@ -77,5 +77,6 @@ class TestBackendRestartRecovery:
                 assert resp.status == 200
         finally:
             await gw.stop()
+            await backend.server.stop(grace=None)  # idempotent
             if restarted is not None:
                 await restarted.__aexit__()
