@@ -58,6 +58,9 @@ class LlamaConfig(common.ModelConfig):
     # (factor, low_freq_factor, high_freq_factor,
     # original_max_position_embeddings); None = unscaled (ops/rope.py).
     rope_scaling: Optional[tuple] = None
+    # Sliding-window attention (Mistral): each query attends to at most
+    # this many most recent keys. None = full causal attention.
+    sliding_window: Optional[int] = None
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
 
@@ -79,6 +82,18 @@ CONFIGS: dict[str, LlamaConfig] = {
         name="llama3-8b", vocab_size=128256, hidden_dim=4096, num_layers=32,
         num_heads=32, num_kv_heads=8, head_dim=128, ffn_dim=14336,
         max_seq_len=8192, rope_theta=500000.0,
+    ),
+    # Mistral-7B-v0.1: Llama-shaped with sliding-window attention —
+    # the same decoder with a 4096-key window mask.
+    "mistral-7b": LlamaConfig(
+        name="mistral-7b", vocab_size=32000, hidden_dim=4096, num_layers=32,
+        num_heads=32, num_kv_heads=8, head_dim=128, ffn_dim=14336,
+        max_seq_len=8192, rope_theta=10000.0, sliding_window=4096,
+    ),
+    "tiny-mistral": LlamaConfig(
+        name="tiny-mistral", vocab_size=512, hidden_dim=256, num_layers=4,
+        num_heads=8, num_kv_heads=4, head_dim=32, ffn_dim=704,
+        max_seq_len=1024, sliding_window=16, dtype="float32",
     ),
 }
 
@@ -284,6 +299,7 @@ def attention_block(
         attn_out = attention(
             q, k_all, v_all, causal=True, q_offset=q_offset, kv_len=kv_len,
             use_flash=use_flash, flash_mesh=flash_mesh,
+            window=cfg.sliding_window,
         )
     attn_out = qmatmul(attn_out.reshape(b, s, h * hd), layer_params["wo"])
     x = x + attn_out

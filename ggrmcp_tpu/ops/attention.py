@@ -52,8 +52,11 @@ def attention_xla(
     causal: bool = True,
     q_offset: Optional[jnp.ndarray] = None,  # [B] absolute pos of q[0]
     kv_len: Optional[jnp.ndarray] = None,  # [B] valid kv length
+    window: Optional[int] = None,  # sliding window (Mistral): each query
+    # attends to at most the `window` most recent keys (incl. itself)
 ) -> jnp.ndarray:
     """Masked softmax attention; scores in float32 for stability."""
+    assert window is None or causal, "sliding window requires causal"
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -66,6 +69,8 @@ def attention_xla(
             q_pos = q_offset[:, None, None] + q_pos[None]  # [B, Sq, 1]
         k_pos = jnp.arange(sk)[None, :]  # [1, Sk]
         causal_mask = q_pos >= k_pos  # [Sq, Sk] or [B, Sq, Sk]
+        if window is not None:
+            causal_mask &= k_pos > q_pos - window
         mask = causal_mask if causal_mask.ndim == 3 else causal_mask[None]
     if kv_len is not None:
         valid = jnp.arange(sk)[None, None, :] < kv_len[:, None, None]  # [B,1,Sk]
@@ -322,6 +327,7 @@ def attention(
     kv_len: Optional[jnp.ndarray] = None,
     use_flash: Optional[bool] = None,
     flash_mesh=None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Pick the right implementation for the shapes at hand. GQA is
     handled here: the flash kernel reads the shared KV heads in place;
@@ -331,8 +337,13 @@ def attention(
     On multi-device meshes the kernel is a custom call GSPMD cannot
     partition: engines either pass False (XLA path) or supply
     `flash_mesh` and the kernel runs per shard via shard_map —
-    batch over data/fsdp, heads over tensor (flash_attention_sharded)."""
+    batch over data/fsdp, heads over tensor (flash_attention_sharded).
+
+    `window` (sliding-window / Mistral-style attention) always takes
+    the XLA path — the flash kernel has no window mask yet."""
     sq, sk = q.shape[1], k.shape[1]
+    if window is not None:
+        use_flash = False
     if use_flash is None:
         use_flash = (
             jax.devices()[0].platform == "tpu"
@@ -356,5 +367,6 @@ def attention(
         k = jnp.repeat(k, h // kvh, axis=2)
         v = jnp.repeat(v, h // kvh, axis=2)
     return attention_xla(
-        q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len
+        q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len,
+        window=window,
     )

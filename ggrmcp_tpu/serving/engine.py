@@ -136,7 +136,7 @@ class GenerationEngine:
             )
             self.flash_mesh = self.mesh if shardable else None
             self.use_flash = None if shardable else False
-        self.kv_dtype = getattr(self.serving, "kv_cache_dtype", "")
+        self.kv_dtype = self.serving.kv_cache_dtype
         if self.kv_dtype:
             # Materializing a bf16 cache for the Pallas kernel would
             # forfeit the int8 bandwidth win — the XLA path fuses the
@@ -145,9 +145,12 @@ class GenerationEngine:
         self._init_sp_prefill()
         self._init_pp_serving()
         if self.pp_serving and self.kv_dtype:
+            # Same rule as config.validate (kept here too: engines are
+            # constructible without a full Config, e.g. in tests).
             raise ValueError(
                 "kv_cache_dtype='int8' is not supported under "
-                "pipeline-parallel serving"
+                "pipeline-parallel serving (the staged forward manages "
+                "its own cache layout)"
             )
         param_specs = (
             self._pp.param_specs_pp(cfg) if self.pp_serving
@@ -198,7 +201,16 @@ class GenerationEngine:
             # The sp path attends with raw bf16 K/V while the cache
             # stores int8 — the same prompt would decode differently
             # through sp vs XLA prefill. Keep numerics path-independent.
-            logger.warning("sp_prefill disabled with kv_cache_dtype=int8")
+            if self._sp_n > 1:
+                logger.warning("sp_prefill disabled with kv_cache_dtype=int8")
+            mode = ""
+        if mode and self.cfg.sliding_window:
+            # Ring/Ulysses attention has no sliding-window mask yet.
+            if self._sp_n > 1:
+                logger.warning(
+                    "sp_prefill disabled for sliding-window model %s",
+                    self.cfg.name,
+                )
             mode = ""
         self.sp_prefill = mode if (self._sp_n > 1 and mode) else ""
         self.sp_min_seq = self.serving.sp_prefill_min_seq

@@ -73,6 +73,8 @@ def read_hf_config(path: str) -> LlamaConfig:
         max_seq_len=hf.get("max_position_embeddings", 4096),
         rope_theta=float(hf.get("rope_theta", 10000.0)),
         rope_scaling=rope_scaling,
+        # Mistral-style sliding window; HF uses null for full attention.
+        sliding_window=hf.get("sliding_window") or None,
         norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         dtype="bfloat16",
     )

@@ -41,9 +41,10 @@ echo "tpu_watch: bench rc=$rc" >&2
 # Best-effort int8 phase once the bf16 headline is in the bag (decode
 # is weight-streaming-bound; int8 shows the quantized serving path).
 if [ "$rc" -eq 0 ] && grep -q '"platform": "tpu"' /tmp/bench_tpu.json; then
-  echo "tpu_watch: running int8 bench" >&2
-  GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_BUDGET_S=900 timeout 1000 \
-    python bench.py > /tmp/bench_tpu_int8.json 2>/tmp/bench_tpu_int8.err
+  echo "tpu_watch: running int8 bench (weights + KV)" >&2
+  GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_KV=int8 GGRMCP_BENCH_BUDGET_S=900 \
+    timeout 1000 python bench.py \
+    > /tmp/bench_tpu_int8.json 2>/tmp/bench_tpu_int8.err
   echo "tpu_watch: int8 bench rc=$?" >&2
 fi
 echo "tpu_watch: done" >&2

@@ -1,0 +1,156 @@
+"""Sliding-window attention (Mistral family): the window mask, its
+equivalence to full attention when the window covers the sequence, and
+cached (prefill+decode) vs uncached numerics through the tiny-mistral
+config (models/llama.py CONFIGS, ops/attention.py window mask)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.core.config import BatchingConfig, MeshConfig, ServingConfig
+from ggrmcp_tpu.models import llama
+from ggrmcp_tpu.ops.attention import attention_xla
+from ggrmcp_tpu.serving.engine import GenerationEngine
+
+CFG = llama.CONFIGS["tiny-mistral"]
+
+
+def naive_windowed(q, k, v, window):
+    """Reference per-position loop: query i attends keys
+    [max(0, i-window+1), i]."""
+    b, s, h, d = q.shape
+    out = np.zeros_like(np.asarray(q), dtype=np.float32)
+    qf = np.asarray(q, np.float32)
+    kf = np.asarray(k, np.float32)
+    vf = np.asarray(v, np.float32)
+    scale = d ** -0.5
+    for bi in range(b):
+        for i in range(s):
+            lo = max(0, i - window + 1)
+            scores = np.einsum(
+                "hd,khd->hk", qf[bi, i], kf[bi, lo : i + 1]
+            ) * scale
+            w = np.exp(scores - scores.max(-1, keepdims=True))
+            w /= w.sum(-1, keepdims=True)
+            out[bi, i] = np.einsum("hk,khd->hd", w, vf[bi, lo : i + 1])
+    return out
+
+
+class TestWindowMask:
+    def test_matches_naive_reference(self):
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (2, 12, 4, 8))
+        k = jax.random.normal(jax.random.fold_in(key, 1), q.shape)
+        v = jax.random.normal(jax.random.fold_in(key, 2), q.shape)
+        out = attention_xla(q, k, v, causal=True, window=5)
+        ref = naive_windowed(q, k, v, 5)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+    def test_window_covering_sequence_equals_full(self):
+        key = jax.random.PRNGKey(3)
+        q = jax.random.normal(key, (1, 10, 2, 8))
+        k = jax.random.normal(jax.random.fold_in(key, 1), q.shape)
+        v = jax.random.normal(jax.random.fold_in(key, 2), q.shape)
+        full = attention_xla(q, k, v, causal=True)
+        windowed = attention_xla(q, k, v, causal=True, window=10)
+        np.testing.assert_allclose(
+            np.asarray(full), np.asarray(windowed), atol=1e-6
+        )
+
+    def test_window_with_offset_and_kv_len(self):
+        """Cached-decode shape: one query at absolute position 20 over
+        a 32-slot cache with 21 valid keys and window 8 must equal the
+        same computation windowed manually."""
+        key = jax.random.PRNGKey(7)
+        q = jax.random.normal(key, (1, 1, 2, 8))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (1, 32, 2, 8))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (1, 32, 2, 8))
+        out = attention_xla(
+            q, k, v, causal=True,
+            q_offset=jnp.asarray([20]), kv_len=jnp.asarray([21]), window=8,
+        )
+        # valid keys: positions 13..20 (window 8 ending at the query)
+        ref = attention_xla(
+            q, k[:, 13:21], v[:, 13:21], causal=False,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+
+class TestMistralModel:
+    def test_cached_matches_uncached(self):
+        """Prefill+decode through the cache must reproduce the
+        uncached windowed forward's logits at each position."""
+        params = llama.init_params(jax.random.PRNGKey(0), CFG)
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(1, 500, (1, 40)), jnp.int32
+        )
+        full_logits, _ = llama.forward(params, CFG, tokens)  # no cache
+        cache = llama.KVCache.create(CFG, 1, 64)
+        pre, cache = llama.forward(params, CFG, tokens[:, :39], cache)
+        dec, _ = llama.forward(params, CFG, tokens[:, 39:40], cache)
+        np.testing.assert_allclose(
+            np.asarray(full_logits[:, 38]), np.asarray(pre[:, -1]),
+            rtol=2e-4, atol=2e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(full_logits[:, 39]), np.asarray(dec[:, -1]),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    def test_window_actually_limits_context(self):
+        """Perturbing a token OUTSIDE the last position's window must
+        not change that position's logits; perturbing inside must."""
+        params = llama.init_params(jax.random.PRNGKey(1), CFG)
+        base = np.random.RandomState(1).randint(1, 500, (1, 40))
+        w = CFG.sliding_window  # 16
+
+        def last_logits(tokens):
+            logits, _ = llama.forward(
+                params, CFG, jnp.asarray(tokens, jnp.int32)
+            )
+            return np.asarray(logits[0, -1])
+
+        ref = last_logits(base)
+        # NOTE: with 4 layers the receptive field is 4*w; position 39's
+        # single-LAYER window is [24, 39], but stacking layers lets
+        # earlier tokens influence later ones transitively. Only tokens
+        # outside the full receptive field are guaranteed inert — with
+        # 40 < 4*16 there are none, so test a 1-layer config instead.
+        one_layer = type(CFG)(**{
+            **{f.name: getattr(CFG, f.name)
+               for f in CFG.__dataclass_fields__.values()},
+            "num_layers": 1,
+        })
+        p1 = llama.init_params(jax.random.PRNGKey(2), one_layer)
+
+        def last1(tokens):
+            logits, _ = llama.forward(
+                p1, one_layer, jnp.asarray(tokens, jnp.int32)
+            )
+            return np.asarray(logits[0, -1])
+
+        ref1 = last1(base)
+        outside = base.copy()
+        outside[0, 5] = (outside[0, 5] + 7) % 500 + 1  # pos 5 < 39-16+1
+        np.testing.assert_allclose(last1(outside), ref1, atol=1e-5)
+        inside = base.copy()
+        inside[0, 30] = (inside[0, 30] + 7) % 500 + 1  # inside window
+        assert np.abs(last1(inside) - ref1).max() > 1e-4
+
+    def test_engine_serving(self):
+        engine = GenerationEngine(
+            CFG,
+            ServingConfig(
+                mesh=MeshConfig(tensor=2, data=0),
+                batching=BatchingConfig(
+                    max_batch_size=4, kv_cache_max_seq=128
+                ),
+            ),
+        )
+        prompts = [[3, 1, 4, 1, 5] * 6, [9, 2, 6]]  # 30 > window of 16
+        outs, reasons = engine.generate(prompts, max_new_tokens=6, seed=0)
+        assert len(outs) == 2 and all(len(o) <= 6 for o in outs)
+        assert all(r in ("length", "stop") for r in reasons)
