@@ -286,7 +286,13 @@ def attention_block(
 
     if attn_impl is not None:
         # Sequence-parallel fresh-prefill: attend over this chunk's
-        # keys (contract above). Ring/Ulysses expect equal head counts.
+        # keys (contract above). Ring/Ulysses expect equal head counts
+        # and have no sliding-window mask — the model layer enforces
+        # its own contract rather than trusting distant engine guards.
+        assert cfg.sliding_window is None, (
+            "attn_impl (sequence-parallel prefill) does not support "
+            "sliding-window attention"
+        )
         if kvh != h:
             reps = h // kvh
             attn_out = attn_impl(

@@ -102,6 +102,7 @@ def _flash_kernel(
     sk: int,
     causal: bool,
     block_q: int,
+    window: Optional[int] = None,
 ):
     """One (batch, head, q_block) cell: online-softmax over k blocks."""
     b_idx = pl.program_id(0)
@@ -125,6 +126,13 @@ def _flash_kernel(
         kv_limit = jnp.minimum(kv_limit, q_off + q_start + block_q)
     kv_limit = jnp.minimum(kv_limit, sk)
     num_iters = (kv_limit + block_k - 1) // block_k
+    # Sliding window: k blocks entirely below the FIRST query's window
+    # hold no visible key for any row of this q block — skip them (the
+    # work saved is what makes windowed prefill O(S·W) not O(S²)).
+    start_iter = 0
+    if window is not None:
+        win_lo = jnp.maximum(q_off + q_start - window + 1, 0)
+        start_iter = win_lo // block_k
 
     def body(kb, carry):
         m_prev, l_prev, acc_prev = carry
@@ -143,6 +151,8 @@ def _flash_kernel(
                 jnp.int32, (block_q, block_k), 0
             )
             mask &= q_pos >= k_pos
+            if window is not None:
+                mask &= k_pos > q_pos - window
         scores = jnp.where(mask, scores, NEG_INF)
         m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
@@ -153,16 +163,21 @@ def _flash_kernel(
         )
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
-    # Fully masked rows (kv_limit == 0) have l == 0; emit zeros. Rows
-    # whose first processed block is fully masked keep m == NEG_INF and
-    # p == exp(0) == 1 — impossible here: causal q_pos >= 0 always
-    # admits k block 0, and kv_limit == 0 skips the loop entirely.
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    m, l, acc = jax.lax.fori_loop(start_iter, num_iters, body, (m0, l0, acc0))
+    # Fully masked rows have l == 0 when the loop never ran; emit
+    # zeros. A row whose PROCESSED blocks are all masked (possible only
+    # for out-of-window pad queries — serving rows always see their own
+    # key) keeps m == NEG_INF with p == exp(0) == 1 accumulating
+    # garbage; zero those rows explicitly rather than emit it.
+    live = m > NEG_INF / 2
+    o_ref[:] = jnp.where(
+        live, acc / jnp.maximum(l, 1e-30), 0.0
+    ).astype(o_ref.dtype)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret", "window"),
 )
 def flash_attention(
     q: jnp.ndarray,  # [B, Sq, H, D]
@@ -174,6 +189,7 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,  # sliding window (causal only)
 ) -> jnp.ndarray:
     """FlashAttention over [B, S, H, D]; S must be a multiple of the
     block sizes (pad upstream; padded keys are masked out via kv_len).
@@ -204,8 +220,10 @@ def flash_attention(
     kh = k.transpose(0, 2, 1, 3)  # [B, KVH, Sk, D]
     vh = v.transpose(0, 2, 1, 3)
 
+    assert window is None or causal, "sliding window requires causal"
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, sk=sk, causal=causal, block_q=block_q
+        _flash_kernel, block_k=block_k, sk=sk, causal=causal,
+        block_q=block_q, window=window,
     )
     out = pl.pallas_call(
         kernel,
@@ -263,6 +281,7 @@ def flash_attention_sharded(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """`flash_attention` on a multi-device mesh: the kernel is a custom
     call GSPMD cannot partition, so shard manually — batch over
@@ -296,6 +315,7 @@ def flash_attention_sharded(
         return flash_attention(
             q, k, v, causal=causal, q_offset=qo, kv_len=kl,
             block_q=block_q, block_k=block_k, interpret=interpret,
+            window=window,
         )
 
     return shard_map(
@@ -339,11 +359,10 @@ def attention(
     `flash_mesh` and the kernel runs per shard via shard_map —
     batch over data/fsdp, heads over tensor (flash_attention_sharded).
 
-    `window` (sliding-window / Mistral-style attention) always takes
-    the XLA path — the flash kernel has no window mask yet."""
+    `window` (sliding-window / Mistral-style attention) is supported by
+    both paths; the kernel additionally SKIPS k blocks below the
+    window, making long windowed prefill O(S·W)."""
     sq, sk = q.shape[1], k.shape[1]
-    if window is not None:
-        use_flash = False
     if use_flash is None:
         use_flash = (
             jax.devices()[0].platform == "tpu"
@@ -355,12 +374,13 @@ def attention(
         if _flash_shardable(flash_mesh, q.shape[0], k.shape[2])[0]:
             return flash_attention_sharded(
                 q, k, v, flash_mesh, causal=causal,
-                q_offset=q_offset, kv_len=kv_len,
+                q_offset=q_offset, kv_len=kv_len, window=window,
             )
         use_flash = False  # per-call shapes don't shard; fall through
     if use_flash:
         return flash_attention(
-            q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len
+            q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len,
+            window=window,
         )
     h, kvh = q.shape[2], k.shape[2]
     if kvh != h:

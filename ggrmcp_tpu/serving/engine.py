@@ -197,21 +197,19 @@ class GenerationEngine:
 
         self._sp_n = mesh_mod.axis_size(self.mesh, "sequence")
         mode = self.serving.sp_prefill
-        if mode and self.kv_dtype:
-            # The sp path attends with raw bf16 K/V while the cache
-            # stores int8 — the same prompt would decode differently
-            # through sp vs XLA prefill. Keep numerics path-independent.
-            if self._sp_n > 1:
-                logger.warning("sp_prefill disabled with kv_cache_dtype=int8")
-            mode = ""
-        if mode and self.cfg.sliding_window:
-            # Ring/Ulysses attention has no sliding-window mask yet.
-            if self._sp_n > 1:
-                logger.warning(
-                    "sp_prefill disabled for sliding-window model %s",
-                    self.cfg.name,
-                )
-            mode = ""
+        # Features sp-prefill cannot compose with (one disable policy):
+        # int8 KV — the sp path attends raw bf16 K/V while the cache
+        # stores int8, so the same prompt would decode differently
+        # through sp vs XLA prefill; sliding window — ring/Ulysses have
+        # no window mask (models/llama.py asserts this too).
+        for incompatible, why in (
+            (self.kv_dtype, "kv_cache_dtype=int8"),
+            (self.cfg.sliding_window, f"sliding-window model {self.cfg.name}"),
+        ):
+            if mode and incompatible:
+                if self._sp_n > 1:
+                    logger.warning("sp_prefill disabled with %s", why)
+                mode = ""
         self.sp_prefill = mode if (self._sp_n > 1 and mode) else ""
         self.sp_min_seq = self.serving.sp_prefill_min_seq
         if not self.sp_prefill:
