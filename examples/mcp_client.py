@@ -1,0 +1,99 @@
+"""Minimal MCP client for the gateway: list tools, call one, stream one.
+
+The zero→aha demo from the client side (the reference's analogue is
+Claude Desktop via mcp-remote; this is the same wire protocol with
+nothing but stdlib + aiohttp):
+
+    # terminal 1 — any gRPC backend, or a TPU sidecar:
+    python examples/hello_server.py --port 50051
+    # terminal 2 — the gateway:
+    python -m ggrmcp_tpu gateway --grpc-port 50051 --http-port 50053
+    # terminal 3:
+    python examples/mcp_client.py --url http://localhost:50053 \
+        --tool hello_helloservice_sayhello --args '{"name": "TPU"}'
+
+Against a generation sidecar (`python -m ggrmcp_tpu gateway --tpu`),
+add --stream to consume the SSE token stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+import aiohttp
+
+
+async def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--url", default="http://localhost:50053")
+    ap.add_argument("--tool", default="")
+    ap.add_argument("--args", default="{}", help="tool arguments (JSON)")
+    ap.add_argument("--stream", action="store_true",
+                    help="consume the SSE streaming variant")
+    opts = ap.parse_args()
+
+    headers: dict[str, str] = {}
+    async with aiohttp.ClientSession(base_url=opts.url) as http:
+        # initialize — capability discovery + session establishment
+        resp = await http.get("/")
+        init = await resp.json()
+        session_id = resp.headers.get("Mcp-Session-Id")
+        if session_id:
+            headers["Mcp-Session-Id"] = session_id
+        info = init["result"]["serverInfo"]
+        print(f"server: {info['name']} {info['version']} "
+              f"(session {session_id})")
+
+        # tools/list
+        resp = await http.post("/", headers=headers, json={
+            "jsonrpc": "2.0", "method": "tools/list", "id": 1,
+        })
+        tools = (await resp.json())["result"]["tools"]
+        print(f"{len(tools)} tools:")
+        for tool in tools:
+            print(f"  {tool['name']}: {tool.get('description', '')[:70]}")
+        if not opts.tool:
+            return 0
+
+        body = {
+            "jsonrpc": "2.0", "method": "tools/call", "id": 2,
+            "params": {
+                "name": opts.tool,
+                "arguments": json.loads(opts.args),
+            },
+        }
+        if opts.stream:
+            # SSE: `event: chunk` deltas, then one `event: result`.
+            resp = await http.post(
+                "/", headers={**headers, "Accept": "text/event-stream"},
+                json=body,
+            )
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = json.loads(line[5:])
+                if "jsonrpc" in payload:  # event: result — final reply
+                    result = payload.get("result", payload.get("error"))
+                    print(f"\n[done] {json.dumps(result)[:200]}")
+                elif "content" in payload:  # event: chunk
+                    inner = json.loads(payload["content"]["text"])
+                    print(inner.get("textDelta", ""), end="", flush=True)
+            return 0
+
+        resp = await http.post("/", headers=headers, json=body)
+        data = await resp.json()
+        if "error" in data:
+            print(f"error: {data['error']}", file=sys.stderr)
+            return 1
+        result = data["result"]
+        for block in result.get("content", []):
+            print(block.get("text", ""))
+        return 1 if result.get("isError") else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
