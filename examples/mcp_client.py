@@ -71,18 +71,23 @@ async def main() -> int:
                 "/", headers={**headers, "Accept": "text/event-stream"},
                 json=body,
             )
+            rc = 1  # stays 1 unless a successful final result arrives
             async for raw in resp.content:
                 line = raw.decode().strip()
                 if not line.startswith("data:"):
                     continue
                 payload = json.loads(line[5:])
                 if "jsonrpc" in payload:  # event: result — final reply
+                    failed = "error" in payload or payload.get(
+                        "result", {}
+                    ).get("isError")
+                    rc = 1 if failed else 0
                     result = payload.get("result", payload.get("error"))
                     print(f"\n[done] {json.dumps(result)[:200]}")
                 elif "content" in payload:  # event: chunk
                     inner = json.loads(payload["content"]["text"])
                     print(inner.get("textDelta", ""), end="", flush=True)
-            return 0
+            return rc
 
         resp = await http.post("/", headers=headers, json=body)
         data = await resp.json()

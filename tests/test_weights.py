@@ -22,8 +22,11 @@ from ggrmcp_tpu.serving.weights import (  # noqa: E402
 )
 
 
-def _tiny_hf_model(tmp_path, tie_embeddings: bool = False, rope_scaling=None):
-    cfg = transformers.LlamaConfig(
+def _tiny_hf_model(tmp_path, tie_embeddings: bool = False, rope_scaling=None,
+                   config_cls=None, model_cls=None, **extra):
+    config_cls = config_cls or transformers.LlamaConfig
+    model_cls = model_cls or transformers.LlamaForCausalLM
+    cfg = config_cls(
         vocab_size=128,
         hidden_size=64,
         intermediate_size=128,
@@ -35,13 +38,25 @@ def _tiny_hf_model(tmp_path, tie_embeddings: bool = False, rope_scaling=None):
         rope_theta=10000.0,
         tie_word_embeddings=tie_embeddings,
         rope_scaling=rope_scaling,
+        **extra,
     )
     torch.manual_seed(0)
-    model = transformers.LlamaForCausalLM(cfg)
+    model = model_cls(cfg)
     model.eval()
     path = tmp_path / "hf-tiny"
     model.save_pretrained(path, safe_serialization=True)
     return model, str(path)
+
+
+def _params_to_f32(params):
+    return {
+        k: (
+            {kk: np.asarray(vv, np.float32) for kk, vv in v.items()}
+            if isinstance(v, dict)
+            else np.asarray(v, np.float32)
+        )
+        for k, v in params.items()
+    }
 
 
 def test_config_derivation(tmp_path):
@@ -61,19 +76,34 @@ def test_logit_parity_with_transformers(tmp_path):
     cfg, params = load_hf_checkpoint(path)
     # float32 end-to-end so the comparison isn't drowned in bf16 noise.
     cfg = type(cfg)(**{**cfg.__dict__, "dtype": "float32"})
-    params = {
-        k: (
-            {kk: np.asarray(vv, np.float32) for kk, vv in v.items()}
-            if isinstance(v, dict)
-            else np.asarray(v, np.float32)
-        )
-        for k, v in params.items()
-    }
+    params = _params_to_f32(params)
 
     tokens = np.array([[1, 5, 9, 23, 87, 3, 44, 101]], np.int32)
     with torch.no_grad():
         ref = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
 
+    ours, _ = llama.forward(params, cfg, tokens)
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-3, rtol=2e-3)
+
+
+def test_mistral_sliding_window_parity(tmp_path):
+    """Mistral-format checkpoint: sliding_window must be derived from
+    config.json and the windowed forward must match transformers'
+    MistralForCausalLM logits (the sequence exceeds the window, so a
+    wrong/missing mask would diverge)."""
+    model, path = _tiny_hf_model(
+        tmp_path,
+        config_cls=transformers.MistralConfig,
+        model_cls=transformers.MistralForCausalLM,
+        sliding_window=4,
+    )
+    cfg, params = load_hf_checkpoint(path)
+    assert cfg.sliding_window == 4
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": "float32"})
+    params = _params_to_f32(params)
+    tokens = np.array([[1, 5, 9, 23, 87, 3, 44, 101, 7, 66]], np.int32)
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
     ours, _ = llama.forward(params, cfg, tokens)
     np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-3, rtol=2e-3)
 
@@ -95,14 +125,7 @@ def test_rope_scaling_logit_parity(tmp_path):
     cfg, params = load_hf_checkpoint(path)
     assert cfg.rope_scaling == (8.0, 1.0, 4.0, 64.0)
     cfg = type(cfg)(**{**cfg.__dict__, "dtype": "float32"})
-    params = {
-        k: (
-            {kk: np.asarray(vv, np.float32) for kk, vv in v.items()}
-            if isinstance(v, dict)
-            else np.asarray(v, np.float32)
-        )
-        for k, v in params.items()
-    }
+    params = _params_to_f32(params)
     # Positions past original_max_position_embeddings exercise the
     # scaled-frequency region.
     tokens = np.arange(96, dtype=np.int32)[None, :] % 128
