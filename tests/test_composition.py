@@ -1,0 +1,113 @@
+"""Kitchen-sink composition: every serving feature enabled at once —
+int8 weights + int8 KV cache + length-tiered pools + prefix caching +
+speculative draft — on one sidecar, driven over real gRPC. Guards
+against feature-interaction regressions that per-feature suites miss.
+"""
+
+import asyncio
+
+import grpc
+import grpc.aio
+
+from ggrmcp_tpu.core.config import (
+    BatchingConfig,
+    MeshConfig,
+    ServingConfig,
+)
+from ggrmcp_tpu.core import config as cfgmod
+from ggrmcp_tpu.rpc.pb import serving_pb2
+from ggrmcp_tpu.serving.sidecar import Sidecar
+
+
+def maximal_serving() -> ServingConfig:
+    return ServingConfig(
+        model="tiny-llama",
+        quantize="int8",
+        kv_cache_dtype="int8",
+        speculative_draft="tiny-llama",
+        mesh=MeshConfig(tensor=2, data=0),
+        batching=BatchingConfig(
+            max_batch_size=4,
+            kv_cache_max_seq=256,
+            kv_tiers=[[64, 3], [256, 2]],
+            prefill_chunk=32,
+            prefix_cache_entries=2,
+            prefix_cache_min_seq=8,
+            prefix_cache_max_seq=32,
+        ),
+    )
+
+
+def test_maximal_config_validates():
+    cfg = cfgmod.default()
+    cfg.serving = maximal_serving()
+    cfg.validate()  # must not raise
+
+
+class TestMaximalSidecar:
+    async def test_all_features_serve_together(self):
+        side = Sidecar(maximal_serving())
+        assert side.spec_batcher is not None  # draft wired
+        assert type(side.batcher).__name__ == "TieredBatcher"
+        port = await side.start(0)
+        channel = grpc.aio.insecure_channel(f"localhost:{port}")
+        try:
+            gen = channel.unary_unary(
+                "/ggrmcp.tpu.GenerateService/Generate",
+                request_serializer=(
+                    serving_pb2.GenerateRequest.SerializeToString
+                ),
+                response_deserializer=serving_pb2.GenerateResponse.FromString,
+            )
+            long_prompt = "shared system preamble " * 4  # > prefix min
+
+            async def call(prompt, temperature):
+                return await gen(serving_pb2.GenerateRequest(
+                    prompt=prompt, max_new_tokens=5,
+                    sampling=serving_pb2.SamplingParams(
+                        temperature=temperature, seed=7
+                    ),
+                ))
+
+            # Greedy → speculative micro-batcher; sampled → tiered
+            # batcher (short tier); long prompt → long tier via the
+            # chunked path, pooling its prefix; repeat → prefix hit.
+            results = await asyncio.gather(
+                call("greedy one", 0.0),
+                call("greedy two", 0.0),
+                call("sampled", 0.9),
+                call(long_prompt + "q1", 0.9),
+                call(long_prompt + "q2", 0.9),
+            )
+            for resp in results:
+                assert resp.finish_reason in ("length", "stop")
+                assert resp.completion_tokens <= 5
+                assert resp.model_id == "tiny-llama"
+
+            # Determinism sanity within the quantized config: a repeat
+            # of the same greedy prompt reproduces its output. (Whether
+            # the first pair actually coalesced is timing-dependent
+            # here; multi-row-vs-solo losslessness is pinned
+            # deterministically in tests/test_speculative.py.)
+            again = await call("greedy one", 0.0)
+            assert again.text == results[0].text
+
+            # ServingStats reflects both planes' activity.
+            stats_rpc = channel.unary_unary(
+                "/ggrmcp.tpu.ModelInfoService/GetServingStats",
+                request_serializer=(
+                    serving_pb2.ServingStatsRequest.SerializeToString
+                ),
+                response_deserializer=(
+                    serving_pb2.ServingStatsResponse.FromString
+                ),
+            )
+            stats = await stats_rpc(serving_pb2.ServingStatsRequest())
+            assert stats.total_slots == 5  # 3 + 2 tier slots
+            assert stats.kv_cache_bytes > 0
+            assert stats.speculative_requests >= 3
+            assert stats.decode_steps >= 1  # sampled traffic decoded
+            assert stats.prefix_cache_hits >= 1  # q2 reused q1's head
+        finally:
+            await channel.close()
+            await side.stop()
