@@ -68,6 +68,8 @@ def main() -> None:
 
     rows = []
     crossover = None
+    win_src = None  # (s, q, kk, vv, t_flash) at the longest seq
+    longest = max(args.seqs, default=0)
     for s in args.seqs:
         q = jax.random.normal(
             key, (args.batch, s, args.heads, args.head_dim)
@@ -92,6 +94,8 @@ def main() -> None:
         if crossover is None and speedup >= 1.0:
             crossover = s
         rows.append((s, t_xla, t_flash, speedup))
+        if s == longest:
+            win_src = (s, q, kk, vv, t_flash)
         print(
             f"S={s:6d}  xla={t_xla:8.3f}ms  flash={t_flash:8.3f}ms  "
             f"flash_speedup={speedup:5.2f}x",
@@ -104,6 +108,19 @@ def main() -> None:
         print(f"| {s} | {t_xla:.3f} | {t_flash:.3f} | {speedup:.2f}x |")
     if crossover is not None:
         print(f"\nsuggested FLASH_MIN_SEQ: {crossover}")
+
+    # Sliding-window skip win at the longest measured length: the
+    # loop's full-causal flash timing vs window = S/2 (the kernel
+    # starts each q-block's k-loop at the window floor —
+    # docs/perf_attention.md). Reuses the loop's tensors and timing.
+    if win_src is not None and win_src[0] >= 512:
+        s, q, kk, vv, t_full = win_src
+        t_win = _time(flash_attention, q, kk, vv, causal=True,
+                      window=s // 2, iters=args.iters)
+        print(
+            f"\nwindowed flash @ S={s}, W={s // 2}: full={t_full:.3f}ms "
+            f"windowed={t_win:.3f}ms ({t_full / max(t_win, 1e-9):.2f}x)"
+        )
 
 
 if __name__ == "__main__":
