@@ -285,6 +285,42 @@ class TestOpsEndpoints:
             assert "gateway_tool_calls_total" in text
             assert 'tool="hello_helloservice_sayhello"' in text
 
+    def test_serving_gauges_update_and_stale_removal(self):
+        """set_serving_stats must (a) set every gauge even when
+        protojson omitted a zero-valued field, and (b) stop exporting
+        targets that disappeared or now error — a dead backend must not
+        keep exporting its last-scraped values."""
+        from ggrmcp_tpu.gateway.metrics import GatewayMetrics
+
+        metrics = GatewayMetrics()
+        if metrics.registry is None:
+            pytest.skip("prometheus_client unavailable")
+        metrics.set_serving_stats([
+            {"target": "a:1", "activeSlots": 4, "kvCacheBytes": "1024"},
+            {"target": "b:2", "activeSlots": 1},
+        ])
+        text = metrics.render()[0].decode()
+        assert 'gateway_backend_active_slots{target="a:1"} 4.0' in text
+        assert 'gateway_backend_kv_cache_bytes{target="a:1"} 1024.0' in text
+        assert 'gateway_backend_active_slots{target="b:2"} 1.0' in text
+
+        # Load drains: protojson omits the now-zero field — the gauge
+        # must still drop to 0, not freeze at 4.
+        metrics.set_serving_stats([
+            {"target": "a:1", "kvCacheBytes": "1024"},
+            {"target": "b:2", "error": "deadline exceeded"},
+        ])
+        text = metrics.render()[0].decode()
+        assert 'gateway_backend_active_slots{target="a:1"} 0.0' in text
+        assert 'target="b:2"' not in text  # errored target removed
+
+        # b recovers: gauges come back.
+        metrics.set_serving_stats([
+            {"target": "a:1"}, {"target": "b:2", "activeSlots": 2},
+        ])
+        text = metrics.render()[0].decode()
+        assert 'gateway_backend_active_slots{target="b:2"} 2.0' in text
+
     async def test_stats_json(self):
         async with gateway_env() as (_, _gw, client):
             resp = await client.get("/stats")
