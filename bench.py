@@ -100,7 +100,10 @@ def _setup_jax():
     return devices
 
 
-def _probe_device(attempts: int = 3, timeout_s: float = 150.0) -> bool:
+def _probe_device(attempts: int = 2, timeout_s: float = 120.0) -> bool:
+    # 2×120 s probing + ≤1200 s CPU fallback stays inside the default
+    # 1500 s watchdog budget — a dead tunnel at the driver's round-end
+    # run must still yield a complete fallback line within budget.
     """Probe the TPU in a SUBPROCESS with its own timeout before
     committing the main process to it: the axon tunnel can hang inside
     backend init where no Python exception can interrupt, and a wedged
