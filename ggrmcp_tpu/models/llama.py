@@ -216,11 +216,19 @@ def attention_block(
     use_flash: Optional[bool] = None,
     flash_mesh: Any = None,
     attn_impl: Optional[Any] = None,
+    ring: bool = False,
 ):
     """Pre-norm GQA attention with residual; shared by the dense and MoE
     decoder families. Returns (x + attn, (cache_k, cache_v) or None).
     K/V keep their KV heads — GQA lives in ops.attention (the flash
     kernel reads shared heads in place; the XLA path repeats them).
+
+    `ring=True` (sliding-window serving): the cache's sequence dim is a
+    RING of capacity C — writes land at `pos % C` and attention masks
+    by each slot's absolute position (ops/attention.py k_positions), so
+    total length may exceed C. Callers must keep every step's write
+    span clear of live window keys: C >= window + step_len - 1
+    (docs/kv_ring_design.md — the engine validates this).
 
     `attn_impl`: optional attention callable `(q, k, v, causal) -> out`
     over the CURRENT chunk's keys only — the sequence-parallel
@@ -250,6 +258,23 @@ def attention_block(
         # indexing with explicit batch indices (compiles to scatter).
         batch_idx = jnp.arange(b)[:, None]  # [B, 1]
         write_pos = cache_len[:, None] + jnp.arange(s)[None, :]  # [B, S]
+        capacity = (
+            cache_k.q.shape[1]
+            if isinstance(cache_k, QuantizedArray) else cache_k.shape[1]
+        )
+        k_positions = None
+        if ring:
+            # Trace-time contract: a windowed model, a step that fits
+            # the ring, and enough capacity that this step's writes
+            # cannot destroy any in-window key before the queries
+            # attend (docs/kv_ring_design.md).
+            assert cfg.sliding_window is not None, "ring needs a window"
+            assert s <= capacity, f"step {s} exceeds ring capacity {capacity}"
+            assert capacity >= cfg.sliding_window + s - 1, (
+                f"ring capacity {capacity} < window "
+                f"{cfg.sliding_window} + step {s} - 1 (clobber)"
+            )
+            write_pos = write_pos % capacity
         if isinstance(cache_k, QuantizedArray):
             # Int8 KV: quantize the step's K/V per position+head and
             # scatter values + scales. Reads dequantize lazily — XLA
@@ -281,8 +306,18 @@ def attention_block(
             k_all, v_all = cache_k, cache_v
         kv_len = cache_len + s
         q_offset = cache_len
+        if ring:
+            # Absolute position currently held by each ring slot j: the
+            # largest p < kv_len with p ≡ j (mod C); negative = slot
+            # never written (ops/attention.py masks those out).
+            slots = jnp.arange(capacity)[None, :]  # [1, C]
+            total = kv_len[:, None]  # [B, 1]
+            k_positions = slots + capacity * (
+                (total - 1 - slots) // capacity
+            )
     else:
         k_all, v_all, kv_len, q_offset = k, v, None, None
+        k_positions = None
 
     if attn_impl is not None:
         # Sequence-parallel fresh-prefill: attend over this chunk's
@@ -305,7 +340,7 @@ def attention_block(
         attn_out = attention(
             q, k_all, v_all, causal=True, q_offset=q_offset, kv_len=kv_len,
             use_flash=use_flash, flash_mesh=flash_mesh,
-            window=cfg.sliding_window,
+            window=cfg.sliding_window, k_positions=k_positions,
         )
     attn_out = qmatmul(attn_out.reshape(b, s, h * hd), layer_params["wo"])
     x = x + attn_out
@@ -326,10 +361,12 @@ def _layer(
     use_flash: Optional[bool] = None,
     flash_mesh: Any = None,
     attn_impl: Optional[Any] = None,
+    ring: bool = False,
 ):
     x, new_cache = attention_block(
         x, layer_params, cfg, positions, cache_k, cache_v, cache_len,
         use_flash=use_flash, flash_mesh=flash_mesh, attn_impl=attn_impl,
+        ring=ring,
     )
 
     # SwiGLU MLP
@@ -349,6 +386,7 @@ def forward(
     use_flash: Optional[bool] = None,
     flash_mesh: Any = None,
     attn_impl: Optional[Any] = None,
+    ring: bool = False,
 ) -> tuple[jnp.ndarray, Optional[KVCache]]:
     """Run the decoder. Without a cache: plain causal forward (training/
     scoring). With a cache: serving — tokens are appended at each
@@ -390,7 +428,7 @@ def forward(
             x, (ck, cv) = _layer(
                 x, layer_params, cfg, positions, ck, cv, cache.length,
                 use_flash=use_flash, flash_mesh=flash_mesh,
-                attn_impl=attn_impl,
+                attn_impl=attn_impl, ring=ring,
             )
             return x, (ck, cv)
 

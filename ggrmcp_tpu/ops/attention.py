@@ -54,9 +54,15 @@ def attention_xla(
     kv_len: Optional[jnp.ndarray] = None,  # [B] valid kv length
     window: Optional[int] = None,  # sliding window (Mistral): each query
     # attends to at most the `window` most recent keys (incl. itself)
+    k_positions: Optional[jnp.ndarray] = None,  # [B, Sk] absolute key
+    # positions (ring-buffer caches); None = contiguous arange layout.
+    # Slots with NEGATIVE positions are invalid (never written).
 ) -> jnp.ndarray:
     """Masked softmax attention; scores in float32 for stability."""
     assert window is None or causal, "sliding window requires causal"
+    assert k_positions is None or (causal and q_offset is not None), (
+        "k_positions (ring layout) requires causal + q_offset"
+    )
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -67,13 +73,22 @@ def attention_xla(
         q_pos = jnp.arange(sq)[:, None]  # [Sq, 1]
         if q_offset is not None:
             q_pos = q_offset[:, None, None] + q_pos[None]  # [B, Sq, 1]
-        k_pos = jnp.arange(sk)[None, :]  # [1, Sk]
-        causal_mask = q_pos >= k_pos  # [Sq, Sk] or [B, Sq, Sk]
+        if k_positions is not None:
+            k_pos = k_positions[:, None, :]  # [B, 1, Sk]
+            causal_mask = (q_pos >= k_pos) & (k_pos >= 0)
+        else:
+            k_pos = jnp.arange(sk)[None, :]  # [1, Sk]
+            causal_mask = q_pos >= k_pos  # [Sq, Sk] or [B, Sq, Sk]
         if window is not None:
             causal_mask &= k_pos > q_pos - window
         mask = causal_mask if causal_mask.ndim == 3 else causal_mask[None]
     if kv_len is not None:
-        valid = jnp.arange(sk)[None, None, :] < kv_len[:, None, None]  # [B,1,Sk]
+        if k_positions is not None:
+            valid = k_positions[:, None, :] < kv_len[:, None, None]
+        else:
+            valid = (
+                jnp.arange(sk)[None, None, :] < kv_len[:, None, None]
+            )  # [B,1,Sk]
         mask = valid if mask is None else mask & valid
     if mask is not None:
         scores = jnp.where(mask[:, None, :, :], scores, NEG_INF)
@@ -348,6 +363,7 @@ def attention(
     use_flash: Optional[bool] = None,
     flash_mesh=None,
     window: Optional[int] = None,
+    k_positions: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Pick the right implementation for the shapes at hand. GQA is
     handled here: the flash kernel reads the shared KV heads in place;
@@ -361,8 +377,13 @@ def attention(
 
     `window` (sliding-window / Mistral-style attention) is supported by
     both paths; the kernel additionally SKIPS k blocks below the
-    window, making long windowed prefill O(S·W)."""
+    window, making long windowed prefill O(S·W).
+
+    `k_positions` (ring-buffer cache layout) always takes the XLA
+    path."""
     sq, sk = q.shape[1], k.shape[1]
+    if k_positions is not None:
+        use_flash = False
     if use_flash is None:
         use_flash = (
             jax.devices()[0].platform == "tpu"
@@ -388,5 +409,5 @@ def attention(
         v = jnp.repeat(v, h // kvh, axis=2)
     return attention_xla(
         q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len,
-        window=window,
+        window=window, k_positions=k_positions,
     )

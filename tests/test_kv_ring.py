@@ -1,0 +1,108 @@
+"""Ring-buffer KV cache (models/llama.py forward(ring=True)): writes at
+pos % C with absolute-position masking, so a sliding-window model's KV
+is bounded by ~window instead of the context. Equivalence contract: as
+long as C >= window + step_len - 1 (docs/kv_ring_design.md), logits
+must match a contiguous-cache run at every step — the window hides
+everything the ring drops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.models import llama
+
+CFG = llama.CONFIGS["tiny-mistral"]  # sliding_window = 16
+W = CFG.sliding_window
+
+
+def step_logits(params, cache, tokens, ring):
+    logits, cache = llama.forward(params, CFG, tokens, cache, ring=ring)
+    return np.asarray(logits), cache
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def run_schedule(params, capacity, ring, steps, kv_dtype=""):
+    """Feed `steps` (list of [B, s] chunks) through one cache; collect
+    the last-position logits of every step."""
+    b = steps[0].shape[0]
+    cache = llama.KVCache.create(CFG, b, capacity, kv_dtype)
+    outs = []
+    for chunk in steps:
+        logits, cache = llama.forward(
+            params, CFG, jnp.asarray(chunk), cache, ring=ring
+        )
+        outs.append(np.asarray(logits[:, -1]))
+    return outs
+
+
+def schedule(total, chunk, b=2, seed=0):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(1, 500, (b, total)).astype(np.int32)
+    return [
+        tokens[:, off : off + chunk] for off in range(0, total, chunk)
+    ]
+
+
+class TestRingEquivalence:
+    def test_ring_matches_contiguous_below_capacity(self, params):
+        steps = schedule(24, 8)  # total 24 <= C = 32
+        ring = run_schedule(params, 32, True, steps)
+        flat = run_schedule(params, 32, False, steps)
+        for r, f in zip(ring, flat):
+            np.testing.assert_allclose(r, f, atol=1e-5)
+
+    def test_ring_matches_contiguous_beyond_capacity(self, params):
+        """Total length 48 through a C=24 ring (W=16, chunks of 8 →
+        C >= W + s - 1 holds) vs a big contiguous cache: the window
+        hides everything the ring overwrote."""
+        steps = schedule(48, 8)
+        ring = run_schedule(params, 24, True, steps)
+        flat = run_schedule(params, 64, False, steps)
+        for i, (r, f) in enumerate(zip(ring, flat)):
+            np.testing.assert_allclose(r, f, atol=1e-5, err_msg=f"step {i}")
+
+    def test_ring_decode_many_wraps(self, params):
+        """Single-token decode across several wrap-arounds at the
+        minimal legal capacity for the largest step (the static clobber
+        assert is conservative over all offsets: C >= W + s_max - 1)."""
+        prefill = schedule(8, 8)
+        decode = schedule(40, 1, seed=3)
+        ring = run_schedule(params, W + 7, True, prefill + decode)
+        flat = run_schedule(params, 64, False, prefill + decode)
+        for i, (r, f) in enumerate(zip(ring, flat)):
+            np.testing.assert_allclose(r, f, atol=1e-5, err_msg=f"step {i}")
+
+    def test_ring_composes_with_int8_kv(self, params):
+        """Slightly looser bound than the float path: the two cache
+        widths (24 vs 64) give different reduction trees, and the
+        resulting last-bit differences amplify through the int8
+        round-trips (~7e-4 observed); top-1 must agree exactly."""
+        steps = schedule(48, 8, seed=5)
+        ring = run_schedule(params, 24, True, steps, kv_dtype="int8")
+        flat = run_schedule(params, 64, False, steps, kv_dtype="int8")
+        for i, (r, f) in enumerate(zip(ring, flat)):
+            np.testing.assert_allclose(
+                r, f, atol=5e-3, rtol=5e-3, err_msg=f"step {i}"
+            )
+            assert (r.argmax(-1) == f.argmax(-1)).all(), f"step {i}"
+
+    def test_clobber_capacity_rejected(self, params):
+        """C < W + s - 1 would destroy in-window keys before the
+        queries attend — the model layer rejects it at trace time."""
+        steps = schedule(48, 8, seed=7)
+        with pytest.raises(AssertionError, match="clobber"):
+            run_schedule(params, W, True, steps)  # C = W: illegal
+        plain = llama.CONFIGS["tiny-llama"]  # no sliding window
+        with pytest.raises(AssertionError, match="window"):
+            llama.forward(
+                llama.init_params(jax.random.PRNGKey(1), plain),
+                plain,
+                jnp.asarray(schedule(8, 8)[0]),
+                llama.KVCache.create(plain, 2, 24),
+                ring=True,
+            )
