@@ -347,6 +347,12 @@ class ServingConfig:
     # context/slot headroom; decode attention takes the XLA path so
     # the cast+scale fuse into the matmuls). Composes with `quantize`.
     kv_cache_dtype: str = ""
+    # Ring-buffer KV for sliding-window models: cache capacity becomes
+    # window + prefill_chunk - 1 instead of the full context, and
+    # generation length is bounded by the model's RoPE range, not KV
+    # HBM (docs/kv_ring_design.md). Batcher-path only; incompatible
+    # with kv_tiers, the prefix pool, and pipeline serving.
+    kv_ring: bool = False
     # Speculative decoding (greedy/lossless): registry key of a small
     # dense draft model sharing the target's vocab ("" → off). Unary
     # greedy Generate calls then verify `speculative_gamma` drafted
@@ -506,6 +512,22 @@ class Config:
                 "pipeline-parallel serving (the staged forward manages "
                 "its own cache layout)"
             )
+        if self.serving.kv_ring:
+            if self.serving.batching.kv_tiers:
+                raise ValueError(
+                    "kv_ring and kv_tiers are mutually exclusive (a "
+                    "ring has ONE capacity: window + prefill_chunk - 1)"
+                )
+            if self.serving.batching.prefix_cache_entries:
+                raise ValueError(
+                    "kv_ring does not compose with the prefix pool "
+                    "(pooled prefixes assume a contiguous layout)"
+                )
+            if self.serving.mesh.stage > 1:
+                raise ValueError(
+                    "kv_ring is not supported under pipeline-parallel "
+                    "serving"
+                )
 
 
 def default() -> Config:

@@ -249,11 +249,11 @@ def moe_ffn(
 
 def _layer(
     x, layer_params, cfg, positions, cache_k, cache_v, cache_len, valid,
-    use_flash=None, flash_mesh=None,
+    use_flash=None, flash_mesh=None, ring=False,
 ):
     x, new_cache = attention_block(
         x, layer_params, cfg, positions, cache_k, cache_v, cache_len,
-        use_flash=use_flash, flash_mesh=flash_mesh,
+        use_flash=use_flash, flash_mesh=flash_mesh, ring=ring,
     )
     normed = common.rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
     ffn_out, aux = moe_ffn(normed, layer_params, cfg, valid)
@@ -268,13 +268,14 @@ def forward(
     valid: Optional[jnp.ndarray] = None,  # [B, S] bool
     use_flash: Optional[bool] = None,
     flash_mesh=None,
+    ring: bool = False,
 ) -> tuple[jnp.ndarray, Optional[KVCache]]:
     """Same contract as `llama.forward` — the engines treat both
     families interchangeably. `valid` marks real (non-padding) tokens
     so padding never competes for expert capacity."""
     logits, cache, _ = forward_with_aux(
         params, cfg, tokens, cache, valid, use_flash=use_flash,
-        flash_mesh=flash_mesh,
+        flash_mesh=flash_mesh, ring=ring,
     )
     return logits, cache
 
@@ -287,6 +288,7 @@ def forward_with_aux(
     valid: Optional[jnp.ndarray] = None,
     use_flash: Optional[bool] = None,
     flash_mesh=None,
+    ring: bool = False,
 ) -> tuple[jnp.ndarray, Optional[KVCache], jnp.ndarray]:
     """Forward returning the mean router load-balance loss (training)."""
     b, s = tokens.shape
@@ -316,7 +318,7 @@ def forward_with_aux(
             layer_params, ck, cv = scanned
             x, (ck, cv), aux = _layer(
                 x, layer_params, cfg, positions, ck, cv, cache.length, valid,
-                use_flash=use_flash, flash_mesh=flash_mesh,
+                use_flash=use_flash, flash_mesh=flash_mesh, ring=ring,
             )
             return x, ((ck, cv), aux)
 

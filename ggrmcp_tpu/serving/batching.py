@@ -96,7 +96,19 @@ class ContinuousBatcher:
 
         b = self.cfg.max_batch_size
         self._steps_per_tick = max(1, self.cfg.decode_steps_per_tick)
-        s_max = min(self.cfg.kv_cache_max_seq, engine.cfg.max_seq_len)
+        # Ring-buffer serving (engine.ring_capacity, sliding-window
+        # models): the cache holds window + prefill_chunk - 1 positions
+        # and request length is bounded by the RoPE range, not the
+        # cache. Short prompts keep the fused admission (a fresh mini
+        # never wraps, so its contiguous layout IS the ring layout);
+        # prompts past prefill_chunk take the chunked path as usual.
+        self._ring = engine.ring_capacity is not None
+        if self._ring:
+            s_max = engine.ring_capacity
+            self._fit_limit = engine.cfg.max_seq_len
+        else:
+            s_max = min(self.cfg.kv_cache_max_seq, engine.cfg.max_seq_len)
+            self._fit_limit = s_max
         self.max_seq = s_max
         self.cache = engine.make_cache(b, s_max)
         # Host-mirrored per-slot state, pushed to device each tick.
@@ -126,6 +138,7 @@ class ContinuousBatcher:
         # overshoot reserve, max_new (>= 1), and the next position.
         poolable = (
             self._pfx_min + 1 <= s_max - (self._steps_per_tick - 1) - 2
+            and not self._ring  # pooled prefixes assume contiguous layout
         )
         if pe > 0 and poolable:
             self._pfx_pool = engine.make_cache(pe, self._pfx_max)
@@ -232,6 +245,7 @@ class ContinuousBatcher:
             logits, cache = self.engine.decode_forward(
                 self.engine.params, cur[:, None], cache,
                 valid=active[:, None] if self._is_moe else None,
+                ring=self._ring,
             )
             nxt = sample_dynamic(logits[:, -1], seeds, step + i, temps, ks, ps)
             return (nxt, cache), nxt
@@ -251,7 +265,7 @@ class ContinuousBatcher:
             valid = None
         # Cache-extending step (not a fresh prefill) → decode_forward.
         logits, mini = self.engine.decode_forward(
-            params, tokens, mini, valid=valid
+            params, tokens, mini, valid=valid, ring=self._ring
         )
         return logits, mini
 
@@ -530,7 +544,11 @@ class ContinuousBatcher:
         # not pay their compiles. Skipped when the chunked path is
         # unreachable (every admissible prompt fits one chunk and no
         # prefix pool routes short prompts through it).
-        if self.cfg.prefill_chunk < self.max_seq or self._pfx_pool is not None:
+        if (
+            self.cfg.prefill_chunk < self.max_seq
+            or self._pfx_pool is not None
+            or self._ring
+        ):
             c = min(self.cfg.prefill_chunk, self.max_seq)
             mini = llama_mod.KVCache.create(
                 self.engine.cfg, 1, self.max_seq, self.engine.kv_dtype
@@ -601,7 +619,7 @@ class ContinuousBatcher:
         # slot's max_new by up to that many positions before the host
         # masks the extra tokens.
         prompt, max_new = fit_request(
-            prompt, max_new, self.max_seq - (self._steps_per_tick - 1)
+            prompt, max_new, self._fit_limit - (self._steps_per_tick - 1)
         )
         request = _Request(
             prompt=prompt, max_new=max_new, sampling=sampling, seed=seed

@@ -142,8 +142,35 @@ class GenerationEngine:
             # forfeit the int8 bandwidth win — the XLA path fuses the
             # cast+scale into the attention matmuls instead.
             self.use_flash, self.flash_mesh = False, None
+        # Ring-buffer KV (sliding-window models, batcher path only):
+        # the shared cache capacity is window + prefill_chunk - 1 (the
+        # static clobber bound for chunked steps), and request length
+        # is bounded by the RoPE range instead of the cache.
+        self.ring_capacity = None
+        if getattr(self.serving, "kv_ring", False):
+            if not getattr(cfg, "sliding_window", None):
+                raise ValueError(
+                    f"kv_ring requires a sliding-window model; "
+                    f"{cfg.name} has none"
+                )
+            cap = (
+                cfg.sliding_window + self.serving.batching.prefill_chunk - 1
+            )
+            if cap > cfg.max_seq_len:
+                # Clamping instead would violate the trace-time clobber
+                # bound the model layer asserts (C >= W + chunk - 1).
+                raise ValueError(
+                    f"kv_ring: sliding_window ({cfg.sliding_window}) + "
+                    f"prefill_chunk "
+                    f"({self.serving.batching.prefill_chunk}) - 1 = {cap} "
+                    f"exceeds max_seq_len ({cfg.max_seq_len}); lower "
+                    f"batching.prefill_chunk"
+                )
+            self.ring_capacity = cap
         self._init_sp_prefill()
         self._init_pp_serving()
+        if self.pp_serving and self.ring_capacity:
+            raise ValueError("kv_ring is not supported under pp serving")
         if self.pp_serving and self.kv_dtype:
             # Same rule as config.validate (kept here too: engines are
             # constructible without a full Config, e.g. in tests).
@@ -282,9 +309,12 @@ class GenerationEngine:
             self.sp_prefill = ""
             self._sp_attn = None
 
-    def decode_forward(self, params, tokens, cache, valid=None):
+    def decode_forward(self, params, tokens, cache, valid=None, ring=False):
         """fam.forward for decode/extension steps (cache already has
-        history). Dispatches to the staged path under PP."""
+        history). Dispatches to the staged path under PP. `ring` is
+        per-call because it describes the CACHE's layout (the batcher's
+        ring-capacity caches), not the engine: the engine's own
+        contiguous request-sized caches keep ring=False."""
         if self.pp_serving:
             return self._pp.pipeline_forward_cached(
                 params, self.cfg, tokens, cache, self.mesh
@@ -293,10 +323,11 @@ class GenerationEngine:
             return self.fam.forward(
                 params, self.cfg, tokens, cache, valid=valid,
                 use_flash=self.use_flash, flash_mesh=self.flash_mesh,
+                ring=ring,
             )
         return self.fam.forward(
             params, self.cfg, tokens, cache, use_flash=self.use_flash,
-            flash_mesh=self.flash_mesh,
+            flash_mesh=self.flash_mesh, ring=ring,
         )
 
     def _init_speculative(self, seed: int) -> None:
