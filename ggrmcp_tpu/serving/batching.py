@@ -104,6 +104,16 @@ class ContinuousBatcher:
         # prompts past prefill_chunk take the chunked path as usual.
         self._ring = engine.ring_capacity is not None
         if self._ring:
+            engine_chunk = engine.serving.batching.prefill_chunk
+            if self.cfg.prefill_chunk > engine_chunk:
+                # The capacity was sized for the ENGINE config's chunk;
+                # a wider batcher chunk would violate the trace-time
+                # clobber bound mid-admission. Fail fast and clearly.
+                raise ValueError(
+                    f"batcher prefill_chunk ({self.cfg.prefill_chunk}) "
+                    f"exceeds the ring engine's ({engine_chunk}); the "
+                    f"ring capacity was sized for the engine's chunk"
+                )
             s_max = engine.ring_capacity
             self._fit_limit = engine.cfg.max_seq_len
         else:

@@ -178,6 +178,10 @@ class TestRingEquivalence:
             cfg.validate()
         cfg.serving.batching.prefix_cache_entries = 0
         cfg.validate()  # ok now
+        cfg.serving.mesh.stage = 2
+        with pytest.raises(ValueError, match="pipeline"):
+            cfg.validate()
+        cfg.serving.mesh.stage = 1
 
         with pytest.raises(ValueError, match="sliding-window"):
             GenerationEngine(
@@ -186,6 +190,36 @@ class TestRingEquivalence:
                     kv_ring=True, mesh=MeshConfig(tensor=2, data=0)
                 ),
             )
+
+        from ggrmcp_tpu.core.config import BatchingConfig
+
+        with pytest.raises(ValueError, match="max_seq_len"):
+            GenerationEngine(
+                CFG,  # W=16, max_seq_len=1024
+                ServingConfig(
+                    kv_ring=True, mesh=MeshConfig(tensor=2, data=0),
+                    batching=BatchingConfig(prefill_chunk=1024),
+                ),
+            )
+
+    async def test_batcher_chunk_mismatch_rejected(self):
+        from ggrmcp_tpu.core.config import (
+            BatchingConfig,
+            MeshConfig,
+            ServingConfig,
+        )
+        from ggrmcp_tpu.serving.batching import ContinuousBatcher
+        from ggrmcp_tpu.serving.engine import GenerationEngine
+
+        engine = GenerationEngine(
+            CFG,
+            ServingConfig(
+                kv_ring=True, mesh=MeshConfig(tensor=2, data=0),
+                batching=BatchingConfig(prefill_chunk=8),
+            ),
+        )
+        with pytest.raises(ValueError, match="ring capacity was sized"):
+            ContinuousBatcher(engine, BatchingConfig(prefill_chunk=16))
 
     def test_clobber_capacity_rejected(self, params):
         """C < W + s - 1 would destroy in-window keys before the
