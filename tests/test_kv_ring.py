@@ -202,6 +202,37 @@ class TestRingEquivalence:
                 ),
             )
 
+    def test_moe_ring_equivalence(self):
+        """The MoE family shares the attention trunk; a windowed MoE
+        config must produce identical logits through a ring cache
+        (beyond capacity) and a contiguous one."""
+        import dataclasses
+
+        from ggrmcp_tpu.models import moe
+
+        mcfg = dataclasses.replace(
+            moe.CONFIGS["tiny-moe"], sliding_window=16
+        )
+        mparams = moe.init_params(jax.random.PRNGKey(4), mcfg)
+        rng = np.random.RandomState(11)
+        tokens = rng.randint(1, 500, (2, 48)).astype(np.int32)
+        chunks = [tokens[:, o : o + 8] for o in range(0, 48, 8)]
+
+        def run(capacity, ring):
+            cache = moe.KVCache.create(mcfg, 2, capacity)
+            outs = []
+            for chunk in chunks:
+                logits, cache = moe.forward(
+                    mparams, mcfg, jnp.asarray(chunk), cache, ring=ring
+                )
+                outs.append(np.asarray(logits[:, -1]))
+            return outs
+
+        ring_outs = run(16 + 8 - 1, True)
+        flat_outs = run(64, False)
+        for i, (r, f) in enumerate(zip(ring_outs, flat_outs)):
+            np.testing.assert_allclose(r, f, atol=1e-5, err_msg=f"step {i}")
+
     async def test_batcher_chunk_mismatch_rejected(self):
         from ggrmcp_tpu.core.config import (
             BatchingConfig,
