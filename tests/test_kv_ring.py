@@ -214,9 +214,7 @@ class TestRingEquivalence:
             moe.CONFIGS["tiny-moe"], sliding_window=16
         )
         mparams = moe.init_params(jax.random.PRNGKey(4), mcfg)
-        rng = np.random.RandomState(11)
-        tokens = rng.randint(1, 500, (2, 48)).astype(np.int32)
-        chunks = [tokens[:, o : o + 8] for o in range(0, 48, 8)]
+        chunks = schedule(48, 8, seed=11)
 
         def run(capacity, ring):
             cache = moe.KVCache.create(mcfg, 2, capacity)
