@@ -190,6 +190,12 @@ class ContinuousBatcher:
         self._pfx_store = jax.jit(self._pfx_store_impl)
         self._pfx_load = jax.jit(self._pfx_load_impl, donate_argnums=(0,))
 
+    def _make_mini(self, rows: int, length: int):
+        """Admission mini cache matching the engine's KV storage."""
+        return llama_mod.KVCache.create(
+            self.engine.cfg, rows, length, self.engine.kv_dtype
+        )
+
     # -- jitted bodies ------------------------------------------------------
 
     def _prefill_sample(self, params, tokens, true_len, seeds, temps, ks, ps):
@@ -197,9 +203,7 @@ class ContinuousBatcher:
         [R, S] against a fresh mini cache, sample each row's first
         token. Returns (first [R], mini cache)."""
         r, s = tokens.shape
-        mini = llama_mod.KVCache.create(
-            self.engine.cfg, r, s, self.engine.kv_dtype
-        )
+        mini = self._make_mini(r, s)
         # Fresh prefill → engine.prefill_forward (handles MoE validity
         # and the sequence-parallel long-chunk path).
         valid = jnp.arange(s)[None, :] < true_len[:, None]
@@ -293,7 +297,10 @@ class ContinuousBatcher:
         prefilled mini row into pool entry `entry` (the same row-merge
         as slot insertion, with the mini clipped to the pool width)."""
         m = self._pfx_max
-        clip = lambda a: a[:, :, :m]  # noqa: E731 — leading-axis slice
+
+        def clip(a):
+            return a[:, :, :m]
+
         clipped = llama_mod.KVCache(
             k=quant.kv_map(clip, mini.k),
             v=quant.kv_map(clip, mini.v),
@@ -368,7 +375,9 @@ class ContinuousBatcher:
         geometry cannot reuse (plan start 0) is not a hit — it neither
         refreshes the LRU stamp nor diverts the request from fused
         admission. Returns (entry, prefix_len) or None."""
-        if self._pfx_pool is None:
+        if self._pfx_pool is None or all(
+            key is None for key in self._pfx_keys
+        ):
             return None
         arr = np.asarray(prompt[: self._pfx_max], np.int32)
         limit = len(prompt) - 1
@@ -439,9 +448,7 @@ class ContinuousBatcher:
         prompt = request.prompt
         n = len(prompt)
         c = min(self.cfg.prefill_chunk, self.max_seq)
-        mini = llama_mod.KVCache.create(
-            self.engine.cfg, 1, self.max_seq, self.engine.kv_dtype
-        )
+        mini = self._make_mini(1, self.max_seq)
         start = 0
         if pfx is not None:
             # Lookup already rejected geometrically unusable matches,
@@ -560,9 +567,7 @@ class ContinuousBatcher:
             or self._ring
         ):
             c = min(self.cfg.prefill_chunk, self.max_seq)
-            mini = llama_mod.KVCache.create(
-                self.engine.cfg, 1, self.max_seq, self.engine.kv_dtype
-            )
+            mini = self._make_mini(1, self.max_seq)
             logits, mini = self._chunk_step(
                 self.engine.params, jnp.asarray(np.zeros((1, c), np.int32)),
                 mini, jnp.asarray(zlen1),
