@@ -93,6 +93,11 @@ def _setup_jax():
     try:
         devices = jax.devices()
     except RuntimeError as exc:
+        if os.environ.get("GGRMCP_BENCH_NO_FALLBACK") == "1":
+            # Watcher stages: burning the stage budget measuring CPU
+            # noise (rejected by have_bench anyway) only delays the
+            # next tunnel probe. Fail fast instead.
+            raise RuntimeError(f"TPU unavailable, no fallback: {exc}")
         print(f"bench: TPU unavailable ({exc}); falling back to CPU",
               file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
@@ -529,12 +534,89 @@ async def _proxy_bench() -> dict:
     }
 
 
+_ARTIFACT_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_artifacts"
+)
+
+
+def _current_round() -> str:
+    """The driver's round counter: it writes exactly one BENCH_r*.json
+    per round, at round end. Must agree with the shell computation in
+    scripts/tpu_watch.sh (`ls BENCH_r*.json | wc -l`)."""
+    import glob
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    return str(len(glob.glob(os.path.join(repo, "BENCH_r*.json"))))
+
+
+def _banked_tpu_line() -> str | None:
+    """On-chip result banked by scripts/tpu_watch.sh earlier in the
+    round. The axon tunnel is opportunistic — it can be alive mid-round
+    and dead at the driver's round-end run — and a captured on-chip
+    number must never be discarded for a CPU fallback. The banked line
+    is emitted verbatim plus {"banked": true, "captured_at": <utc>} so
+    a reader can tell it from a live measurement; TPU_ATTEMPTS.log has
+    the full attempt audit trail. Preference order: flagship bf16, then
+    int8, then tiny."""
+    if os.environ.get("GGRMCP_BENCH_NO_BANK") == "1":
+        return None  # the watcher's own runs must measure, not re-emit
+    # Round guard: the watcher stamps bench_artifacts/.round with
+    # _current_round(). A stamp from a previous round — or no stamp at
+    # all (watcher never ran) — means any artifacts here are stale and
+    # must not become this round's number.
+    try:
+        with open(os.path.join(_ARTIFACT_DIR, ".round")) as f:
+            stamped = f.read().strip()
+    except OSError:
+        return None
+    if stamped != _current_round():
+        return None
+    for name in ("bench_tpu.json", "bench_tpu_int8.json",
+                 "bench_tpu_tiny.json"):
+        path = os.path.join(_ARTIFACT_DIR, name)
+        try:
+            with open(path) as f:
+                lines = [ln for ln in f.read().splitlines()
+                         if ln.lstrip().startswith("{")]
+            rec = json.loads(lines[-1])
+            # inside the try: a watcher restart can mv the artifact
+            # into its archive between the read and this stat
+            mtime = os.path.getmtime(path)
+        except (OSError, IndexError, ValueError):
+            continue
+        if rec.get("platform") == "tpu" and "value" in rec:
+            rec["banked"] = True
+            rec["captured_at"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime)
+            )
+            return json.dumps(rec)
+    return None
+
+
 def _cpu_fallback(reason: str) -> None:
     """Re-run the bench on the CPU platform in a fresh subprocess (the
     wedged TPU runtime can't be torn down in-process) so a result line
-    is always produced."""
+    is always produced. A banked on-chip line from earlier in the round
+    takes precedence over measuring CPU noise."""
     import subprocess
 
+    banked = _banked_tpu_line()
+    if banked is not None:
+        print(f"bench: TPU unavailable ({reason}); emitting banked "
+              "on-chip result (see TPU_ATTEMPTS.log)", file=sys.stderr)
+        _emit(banked)
+        return
+    if os.environ.get("GGRMCP_BENCH_NO_FALLBACK") == "1":
+        # Watcher stages set this: when the tunnel dies mid-stage a
+        # 20-minute CPU re-measurement would only delay the next probe
+        # during exactly the short windows the watcher exists to catch.
+        print(f"bench: no fallback ({reason})", file=sys.stderr)
+        _emit(json.dumps({
+            "metric": "mcp_generate_calls_per_sec", "value": 0.0,
+            "unit": "calls/s", "vs_baseline": 0.0, "platform": "none",
+            "error": reason,
+        }))
+        return
     print(f"bench: falling back to CPU ({reason})", file=sys.stderr)
     env = dict(os.environ, GGRMCP_BENCH_CPU="1", GGRMCP_BENCH_SESSIONS="8",
                GGRMCP_BENCH_CALLS="64")

@@ -121,6 +121,10 @@ def main() -> None:
             f"\nwindowed flash @ S={s}, W={s // 2}: full={t_full:.3f}ms "
             f"windowed={t_win:.3f}ms ({t_full / max(t_win, 1e-9):.2f}x)"
         )
+    # Completion marker: the platform= header prints before any
+    # measurement, so artifact validity checks (scripts/tpu_watch.sh
+    # have_attn) need proof the table actually finished.
+    print("\nATTN-BENCH-COMPLETE", flush=True)
 
 
 if __name__ == "__main__":

@@ -1,50 +1,180 @@
 #!/usr/bin/env bash
-# Watch for the axon TPU tunnel to come back, then capture the
-# on-chip evidence in one shot:
-#   1. flash-vs-XLA attention table  -> /tmp/attn_bench.txt
-#   2. full-stack TPU benchmark line -> /tmp/bench_tpu.json
-# Probes in a subprocess with its own timeout (a wedged tunnel hangs
-# uninterruptibly inside backend init). Gives up after MAX_WAIT_S.
+# Round-long opportunistic TPU capture with an auditable attempt log.
+#
+# The axon TPU tunnel comes and goes; any 60-second window must be
+# enough to bank a first on-chip number. So this watcher:
+#   * probes every PROBE_EVERY_S for the whole round (MAX_WAIT_S),
+#     appending EVERY attempt + outcome with a UTC timestamp to
+#     TPU_ATTEMPTS.log (committed — the audit trail);
+#   * on probe success runs an escalation ladder, cheapest first, each
+#     stage writing its artifact to bench_artifacts/ BEFORE the next
+#     stage starts, so a dying tunnel can't take finished results
+#     with it:
+#       a. tiny-llama full-stack bench  -> bench_artifacts/bench_tpu_tiny.json
+#       b. llama-1b bf16 bench (+MFU)   -> bench_artifacts/bench_tpu.json
+#       c. flash-vs-XLA attention table -> bench_artifacts/attn_bench.txt
+#       d. int8 weights + int8 KV bench -> bench_artifacts/bench_tpu_int8.json
+#   * skips stages whose artifact is already on-chip-valid, so a tunnel
+#     that dies mid-ladder resumes where it left off next time.
+#
+# bench.py emits a banked on-chip artifact (clearly labeled
+# "banked": true) when the driver's round-end run finds no live TPU —
+# see _banked_tpu_line().  GGRMCP_BENCH_NO_BANK=1 below keeps the
+# watcher's own runs from re-emitting a previously banked line as if
+# it were fresh.
 set -u
 cd "$(dirname "$0")/.."
-MAX_WAIT_S=${MAX_WAIT_S:-18000}
-PROBE_EVERY_S=${PROBE_EVERY_S:-300}
+LOG=${TPU_LOG:-TPU_ATTEMPTS.log}
+ART=bench_artifacts
+mkdir -p "$ART"
+MAX_WAIT_S=${MAX_WAIT_S:-41400}     # ~11.5 h: the whole round
+PROBE_EVERY_S=${PROBE_EVERY_S:-180}
 start=$(date +%s)
-while true; do
-  now=$(date +%s)
-  if (( now - start > MAX_WAIT_S )); then
-    echo "tpu_watch: gave up after ${MAX_WAIT_S}s" >&2
-    exit 1
+export GGRMCP_BENCH_NO_BANK=1      # watcher runs must measure, not re-emit
+export GGRMCP_BENCH_NO_FALLBACK=1  # dead tunnel mid-stage: fail fast, re-probe
+
+# Single instance: two watchers would double-book the tunnel and
+# truncate each other's in-progress artifacts (> redirections). The
+# lock dies with the process, so a crashed watcher never wedges it.
+exec 9>"$ART/.watch.lock"
+if ! flock -n 9; then
+  echo "tpu_watch: another instance holds $ART/.watch.lock; exiting" >&2
+  exit 0
+fi
+
+# Artifacts from a PREVIOUS round must not satisfy this round's ladder
+# (or get re-banked as this round's result) — but a watcher restart
+# within the same round must keep them (they may be the round's only
+# on-chip capture). mtime can't distinguish rounds (git checkout
+# refreshes it), so use the driver's own round counter: it writes
+# exactly one BENCH_r*.json per round, at round end. Re-synced every
+# loop iteration, not just at startup — a watcher that outlives the
+# round boundary must not bank new captures under the old stamp.
+sync_round() {
+  local round_id
+  round_id=$(ls BENCH_r*.json 2>/dev/null | wc -l | tr -d ' ')
+  [ "$(cat "$ART/.round" 2>/dev/null)" = "$round_id" ] && return 0
+  local stale=()
+  local f
+  for f in "$ART"/bench_tpu*.json "$ART"/attn_bench.txt; do
+    [ -e "$f" ] && stale+=("$f")
+  done
+  if [ ${#stale[@]} -gt 0 ]; then
+    local arch="$ART/archive_$(date -u +%Y%m%dT%H%M%SZ)"
+    mkdir -p "$arch"
+    mv "${stale[@]}" "$arch/"
+    note "round rolled to $round_id: archived ${#stale[@]} artifact(s) to $arch"
   fi
-  if timeout 120 python -c "
+  echo "$round_id" > "$ART/.round"
+}
+
+note() {
+  echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) $*" >> "$LOG"
+  echo "tpu_watch: $*" >&2
+}
+
+probe() {
+  local out rc
+  # stderr is kept: the audit log must distinguish "tunnel down"
+  # (timeout, rc=124) from environment breakage (ImportError, PJRT
+  # misconfig), or it can't serve as evidence.
+  out=$(timeout 120 python -c "
 import jax
-assert jax.devices()[0].platform == 'tpu'
-print('PROBE-OK')" 2>/dev/null | grep -q PROBE-OK; then
-    echo "tpu_watch: TPU is back ($(date -u +%H:%M:%S))" >&2
-    break
+d = jax.devices()
+print('PROBE-OK', d[0].platform, d[0].device_kind, len(d), flush=True)
+" 2>&1)
+  rc=$?
+  if [ $rc -eq 0 ] && echo "$out" | grep -q 'PROBE-OK tpu'; then
+    note "probe ok: $(echo "$out" | grep 'PROBE-OK')"
+    return 0
   fi
-  echo "tpu_watch: still down ($(date -u +%H:%M:%S))" >&2
-  sleep "$PROBE_EVERY_S"
-done
+  note "probe failed rc=$rc out='$(echo "${out:-<none>}" | tail -c 300 | tr '\n' ' ')'"
+  return 1
+}
 
-echo "tpu_watch: running attention bench" >&2
-timeout 900 python scripts/bench_attention.py --iters 10 \
-  --seqs 256 512 1024 2048 4096 > /tmp/attn_bench.txt 2>/tmp/attn_bench.err
-echo "tpu_watch: attention bench rc=$?" >&2
+# A bench artifact counts only if its result line really came from the
+# chip (the in-bench CPU fallback writes platform=cpu lines here when
+# the tunnel dies mid-run; those are retried, not kept). The attention
+# table prints its platform header BEFORE measuring, so it also needs
+# the completion marker bench_attention.py prints at the very end.
+have_bench() { [ -f "$ART/$1" ] && grep -q '"platform": "tpu"' "$ART/$1"; }
+have_attn()  {
+  [ -f "$ART/attn_bench.txt" ] \
+    && grep -q '^platform=tpu' "$ART/attn_bench.txt" \
+    && grep -q 'ATTN-BENCH-COMPLETE' "$ART/attn_bench.txt"
+}
 
-echo "tpu_watch: running full-stack bench" >&2
-GGRMCP_BENCH_BUDGET_S=1200 timeout 1300 python bench.py \
-  > /tmp/bench_tpu.json 2>/tmp/bench_tpu.err
-rc=$?
-echo "tpu_watch: bench rc=$rc" >&2
+stage_tiny() {
+  note "stage tiny-llama: start"
+  GGRMCP_BENCH_MODEL=tiny-llama GGRMCP_BENCH_SESSIONS=8 GGRMCP_BENCH_CALLS=64 \
+    GGRMCP_BENCH_BUDGET_S=600 timeout 660 python bench.py \
+    > "$ART/bench_tpu_tiny.json" 2> "$ART/bench_tpu_tiny.err"
+  note "stage tiny-llama: rc=$? on_chip=$(have_bench bench_tpu_tiny.json && echo yes || echo no)"
+  have_bench bench_tpu_tiny.json
+}
 
-# Best-effort int8 phase once the bf16 headline is in the bag (decode
-# is weight-streaming-bound; int8 shows the quantized serving path).
-if [ "$rc" -eq 0 ] && grep -q '"platform": "tpu"' /tmp/bench_tpu.json; then
-  echo "tpu_watch: running int8 bench (weights + KV)" >&2
+stage_1b() {
+  note "stage llama-1b bf16: start"
+  GGRMCP_BENCH_BUDGET_S=1200 timeout 1300 python bench.py \
+    > "$ART/bench_tpu.json" 2> "$ART/bench_tpu.err"
+  note "stage llama-1b bf16: rc=$? on_chip=$(have_bench bench_tpu.json && echo yes || echo no)"
+  have_bench bench_tpu.json
+}
+
+stage_attn() {
+  note "stage attention table: start"
+  timeout 900 python scripts/bench_attention.py --iters 10 \
+    --seqs 256 512 1024 2048 4096 \
+    > "$ART/attn_bench.txt" 2> "$ART/attn_bench.err"
+  note "stage attention table: rc=$? on_chip=$(have_attn && echo yes || echo no)"
+  have_attn
+}
+
+stage_int8() {
+  note "stage llama-1b int8+int8kv: start"
   GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_KV=int8 GGRMCP_BENCH_BUDGET_S=900 \
     timeout 1000 python bench.py \
-    > /tmp/bench_tpu_int8.json 2>/tmp/bench_tpu_int8.err
-  echo "tpu_watch: int8 bench rc=$?" >&2
-fi
-echo "tpu_watch: done" >&2
+    > "$ART/bench_tpu_int8.json" 2> "$ART/bench_tpu_int8.err"
+  note "stage llama-1b int8+int8kv: rc=$? on_chip=$(have_bench bench_tpu_int8.json && echo yes || echo no)"
+  have_bench bench_tpu_int8.json
+}
+
+all_done() {
+  have_bench bench_tpu_tiny.json && have_bench bench_tpu.json \
+    && have_attn && have_bench bench_tpu_int8.json
+}
+
+run_ladder() {
+  have_bench bench_tpu_tiny.json || stage_tiny || probe || return 1
+  have_bench bench_tpu.json      || stage_1b   || probe || return 1
+  have_attn                      || stage_attn || probe || return 1
+  have_bench bench_tpu_int8.json || stage_int8 || probe || return 1
+  return 0
+}
+
+note "watcher started (pid $$, max_wait=${MAX_WAIT_S}s, probe_every=${PROBE_EVERY_S}s)"
+while true; do
+  sync_round
+  if all_done; then
+    note "all stages captured on chip; watcher exiting"
+    exit 0
+  fi
+  now=$(date +%s)
+  if (( now - start > MAX_WAIT_S )); then
+    note "gave up after ${MAX_WAIT_S}s (captured: tiny=$(have_bench bench_tpu_tiny.json && echo y || echo n) 1b=$(have_bench bench_tpu.json && echo y || echo n) attn=$(have_attn && echo y || echo n) int8=$(have_bench bench_tpu_int8.json && echo y || echo n))"
+    exit 1
+  fi
+  if probe; then
+    # Cheapest-first. A stage failure does NOT gate the later stages:
+    # re-probe, and only abandon the pass if the tunnel is actually
+    # gone — otherwise a stage-specific failure (e.g. one model's
+    # compile exceeding its budget) would block the flagship bench for
+    # the whole round. Completed stages are kept and skipped.
+    run_ladder
+    # A pass that didn't finish everything always sleeps before the
+    # next attempt so a fast-failing stage can't spin the loop.
+    all_done || sleep "$PROBE_EVERY_S"
+  else
+    sleep "$PROBE_EVERY_S"
+  fi
+done
