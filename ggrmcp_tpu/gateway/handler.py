@@ -389,8 +389,10 @@ class MCPHandler:
         stats = self.discoverer.get_service_stats()
         healthy_backends = sum(1 for b in stats["backends"] if b["healthy"])
         self.metrics.set_gauges(self.sessions.count(), healthy_backends)
+        # Snapshot, not live fan-out: a wedged sidecar must not add its
+        # gRPC timeout to every Prometheus scrape.
         self.metrics.set_serving_stats(
-            await self.discoverer.get_backend_serving_stats()
+            await self.discoverer.get_serving_stats_snapshot()
         )
         payload, content_type = self.metrics.render()
         return web.Response(body=payload, content_type=content_type.split(";")[0])

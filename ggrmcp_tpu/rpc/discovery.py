@@ -122,6 +122,13 @@ class ServiceDiscoverer:
         # replica (tool A always landing on even counts, B on odd).
         self._rr: dict[str, itertools.count] = {}
         self._watchdog_task: Optional[asyncio.Task] = None
+        # ServingStats snapshot for /metrics: a Prometheus scrape must
+        # not block on a live gRPC fan-out (a wedged sidecar would add
+        # its whole timeout to every scrape), so scrapes read this and
+        # trigger a background refresh when stale.
+        self._serving_stats_cache: list[dict[str, Any]] = []
+        self._serving_stats_at = 0.0  # time.monotonic of last refresh
+        self._serving_stats_task: Optional[asyncio.Task] = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -233,6 +240,15 @@ class ServiceDiscoverer:
 
     async def close(self) -> None:
         await self.stop_watchdog()
+        if self._serving_stats_task is not None:
+            # an in-flight snapshot refresh must not outlive the
+            # backends it fans out to
+            self._serving_stats_task.cancel()
+            try:
+                await self._serving_stats_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._serving_stats_task = None
         await asyncio.gather(
             *(b.close() for b in self.backends), return_exceptions=True
         )
@@ -396,6 +412,36 @@ class ServiceDiscoverer:
             if mi is not None:
                 jobs.append(call(backend, mi))
         return list(await asyncio.gather(*jobs)) if jobs else []
+
+    async def get_serving_stats_snapshot(
+        self, max_age_s: float = 5.0, first_wait_s: float = 0.5
+    ) -> list[dict[str, Any]]:
+        """Last-known ServingStats for the Prometheus scrape path:
+        returns the cached snapshot immediately and refreshes it in the
+        background when older than max_age_s, so scrape latency never
+        couples to backend responsiveness. The very first scrape (no
+        snapshot yet) waits up to first_wait_s for the refresh so a
+        healthy stack doesn't export an empty first sample."""
+        now = time.monotonic()
+        stale = now - self._serving_stats_at >= max_age_s
+        if stale and (
+            self._serving_stats_task is None
+            or self._serving_stats_task.done()
+        ):
+            async def refresh() -> None:
+                stats = await self.get_backend_serving_stats()
+                self._serving_stats_cache = stats
+                self._serving_stats_at = time.monotonic()
+
+            self._serving_stats_task = asyncio.create_task(refresh())
+        if self._serving_stats_at == 0.0 and self._serving_stats_task:
+            try:
+                await asyncio.wait_for(
+                    asyncio.shield(self._serving_stats_task), first_wait_s
+                )
+            except Exception:  # noqa: BLE001
+                pass  # scrape must never fail on a slow backend
+        return list(self._serving_stats_cache)
 
     async def health_check(self) -> bool:
         """Healthy iff at least one backend passes its deep check."""

@@ -807,6 +807,11 @@ class ContinuousBatcher:
         trickle = len(batch) == 1
         for sl, req in zip(slots_idx, batch):
             pfx = self._pfx_lookup(req.prompt)
+            if pfx is None and self._pfx_pool is not None:
+                # Every pool-enabled lookup miss counts — fused-path
+                # admissions included — or the exported hit/miss ratio
+                # overstates the pool's effectiveness.
+                self.prefix_misses += 1
             if pfx is not None:
                 self._prefill_chunked(sl, req, pfx)
             elif len(req.prompt) > self.cfg.prefill_chunk or (
@@ -818,8 +823,6 @@ class ContinuousBatcher:
                 # prefixes from bursts.
                 trickle and self._pfx_storable(req.prompt) is not None
             ):
-                if self._pfx_pool is not None:
-                    self.prefix_misses += 1
                 self._prefill_chunked(sl, req)
             else:
                 fused_slots.append(sl)

@@ -174,3 +174,19 @@ class TestPrefixCache:
         for (out, reason), exp in zip(outs, expected):
             assert reason in ("length", "stop")
             assert out == exp
+
+    async def test_burst_misses_are_counted(self, engine):
+        """ADVICE r2: admissions that miss the pool must count as
+        misses on EVERY path — fused/burst included — or the exported
+        hit/miss ratio overstates the pool's effectiveness."""
+        batcher = ContinuousBatcher(engine, batching_cfg())
+        batcher.start()
+        try:
+            await asyncio.gather(*(
+                collect(batcher, prompt_of(6, salt=i), 4, seed=i)
+                for i in range(3)
+            ))
+            assert batcher.prefix_hits == 0
+            assert batcher.prefix_misses == 3
+        finally:
+            await batcher.stop()

@@ -293,3 +293,50 @@ class TestDescriptorSet:
         )
         assert trim_service_name("hello.HelloService") == "hello.HelloService"
         assert trim_service_name("Bare") == "Bare"
+
+
+class TestServingStatsSnapshot:
+    """ADVICE r2: a Prometheus scrape must never block on a live gRPC
+    fan-out — /metrics reads a snapshot refreshed in the background."""
+
+    async def test_scrape_never_waits_for_wedged_backend(self):
+        import time
+
+        disc = ServiceDiscoverer([])
+        calls = {"n": 0}
+
+        async def slow_fanout(timeout_s: float = 2.0):
+            calls["n"] += 1
+            await asyncio.sleep(0.5)  # a wedged sidecar
+            return [{"target": "t", "totalSlots": "1"}]
+
+        disc.get_backend_serving_stats = slow_fanout
+        t0 = time.monotonic()
+        out = await disc.get_serving_stats_snapshot(first_wait_s=0.05)
+        took = time.monotonic() - t0
+        # first scrape: empty snapshot, bounded wait, refresh kicked off
+        assert out == []
+        assert took < 0.4
+        assert calls["n"] == 1
+        await disc._serving_stats_task
+        # snapshot is fresh now: served instantly, no second fan-out
+        out2 = await disc.get_serving_stats_snapshot(first_wait_s=0.05)
+        assert out2 == [{"target": "t", "totalSlots": "1"}]
+        assert calls["n"] == 1
+
+    async def test_stale_snapshot_served_while_refreshing(self):
+        disc = ServiceDiscoverer([])
+
+        async def fanout(timeout_s: float = 2.0):
+            await asyncio.sleep(0.2)
+            return [{"target": "t", "fresh": "yes"}]
+
+        disc.get_backend_serving_stats = fanout
+        disc._serving_stats_cache = [{"target": "t", "fresh": "no"}]
+        disc._serving_stats_at = 1e-9  # ancient but nonzero
+        out = await disc.get_serving_stats_snapshot(max_age_s=0.0)
+        # stale data returned immediately; background refresh lands later
+        assert out == [{"target": "t", "fresh": "no"}]
+        await disc._serving_stats_task
+        out2 = await disc.get_serving_stats_snapshot()
+        assert out2 == [{"target": "t", "fresh": "yes"}]
