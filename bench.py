@@ -407,6 +407,60 @@ async def _run_bench() -> dict:
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: prefix phase failed: {exc!r}", file=sys.stderr)
 
+        # Long-prompt phase: prompts past FLASH_MIN_SEQ so a TPU run
+        # exercises the Pallas flash kernel in situ — the headline
+        # phase's short prompts never reach it, so without this a
+        # successful TPU bench validates the XLA path only. Prompts are
+        # distinct (burst learning stores nothing; concurrent arrival
+        # keeps them on the fused admission path at the 512 bucket).
+        longp = {}
+        try:
+            from ggrmcp_tpu.ops.attention import FLASH_MIN_SEQ
+
+            tgt = FLASH_MIN_SEQ + 164  # tokens ≈ chars (byte tokenizer)
+            long_latencies: list[float] = []
+
+            async def long_call(i: int) -> None:
+                text = f"case {i}: " + ("the quick brown fox %03d " % i) * 64
+                body = {
+                    "jsonrpc": "2.0", "method": "tools/call",
+                    "id": 80000 + i,
+                    "params": {
+                        "name": tool,
+                        "arguments": {
+                            "prompt": text[:tgt],
+                            "maxNewTokens": max_new,
+                        },
+                    },
+                }
+                t = time.perf_counter()
+                resp = await client.post("/", json=body)
+                data = await resp.json()
+                long_latencies.append(time.perf_counter() - t)
+                if "error" in data:
+                    raise RuntimeError(f"long call failed: {data['error']}")
+
+            await long_call(0)  # compile the long bucket off the clock
+            n_long = max(4, sessions // 2)
+            long_start = time.perf_counter()
+            results = await asyncio.gather(
+                *(long_call(1 + i) for i in range(n_long)),
+                return_exceptions=True,
+            )
+            errs = [r for r in results if isinstance(r, BaseException)]
+            if errs:
+                raise errs[0]
+            long_elapsed = time.perf_counter() - long_start
+            longp = {
+                "long_calls_per_sec": round(n_long / long_elapsed, 2),
+                "long_p50_ms": round(
+                    statistics.median(long_latencies[1:]) * 1000, 1
+                ),
+                "long_prompt_tokens": tgt,
+            }
+        except Exception as exc:  # secondary phase must not sink the run
+            print(f"bench: long-prompt phase failed: {exc!r}", file=sys.stderr)
+
     # Device memory while the serving stack is live (KV cache + params
     # resident) — the VERDICT r1 #9 "measured HBM" extra.
     hbm = {}
@@ -431,7 +485,7 @@ async def _run_bench() -> dict:
     except Exception as exc:  # secondary metric must not sink the run
         print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
         proxy = {}
-    return {**headline, **hbm, **prefix, **proxy}
+    return {**headline, **hbm, **prefix, **longp, **proxy}
 
 
 async def _proxy_bench() -> dict:

@@ -506,12 +506,9 @@ class Config:
                 f"unknown serving.kv_cache_dtype "
                 f"{self.serving.kv_cache_dtype!r}; supported: 'int8'"
             )
-        if self.serving.kv_cache_dtype and self.serving.mesh.stage > 1:
-            raise ValueError(
-                "kv_cache_dtype='int8' is not supported under "
-                "pipeline-parallel serving (the staged forward manages "
-                "its own cache layout)"
-            )
+        # kv_cache_dtype='int8' composes with mesh.stage > 1: the
+        # staged forward threads QuantizedArray K/V leaves through its
+        # tick schedule (parallel/pipeline.py::_pipelined_cached).
         if self.serving.kv_ring:
             if self.serving.batching.kv_tiers:
                 raise ValueError(

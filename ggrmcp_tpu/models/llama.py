@@ -95,6 +95,20 @@ CONFIGS: dict[str, LlamaConfig] = {
         num_heads=8, num_kv_heads=4, head_dim=32, ffn_dim=704,
         max_seq_len=1024, sliding_window=16, dtype="float32",
     ),
+    # Long-context exercise configs (SURVEY §5.7): tiny dims keep an
+    # 8k-position prompt CPU-feasible while the serving geometry —
+    # chunked prefill, length tiers, ring KV — runs at REAL lengths
+    # (tests/test_long_context.py).
+    "tiny-llama-8k": LlamaConfig(
+        name="tiny-llama-8k", vocab_size=512, hidden_dim=256, num_layers=4,
+        num_heads=8, num_kv_heads=4, head_dim=32, ffn_dim=704,
+        max_seq_len=8192, dtype="float32",
+    ),
+    "tiny-mistral-8k": LlamaConfig(
+        name="tiny-mistral-8k", vocab_size=512, hidden_dim=256,
+        num_layers=4, num_heads=8, num_kv_heads=4, head_dim=32, ffn_dim=704,
+        max_seq_len=8192, sliding_window=1024, dtype="float32",
+    ),
 }
 
 

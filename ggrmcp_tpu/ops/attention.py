@@ -21,8 +21,10 @@ Two implementations with one contract:
   interpret mode off-TPU so the same code path is unit-tested on the
   CPU mesh.
 
-`attention` picks per call: flash for long prefill on TPU (measured
-crossover — see docs/perf_attention.md), XLA otherwise. Shapes are
+`attention` picks per call: flash for long prefill on TPU (crossover
+threshold FLASH_MIN_SEQ — an op-count estimate until silicon fills
+docs/perf_attention.md's table; scripts/bench_attention.py measures
+it), XLA otherwise. Shapes are
 [batch, seq, heads, head_dim]; K/V may carry fewer (KV) heads, the
 dispatcher repeats them only for the XLA path.
 """
@@ -349,7 +351,10 @@ def flash_attention_sharded(
 
 # Prefill sequences at least this long go through the Pallas kernel on
 # TPU; below it the fused XLA path wins (kernel launch + padding costs).
-# Set from on-chip measurement — see docs/perf_attention.md.
+# PROVENANCE: op-count estimate, not yet silicon — when the tunnel
+# yields chip time, scripts/bench_attention.py (tpu_watch stage c)
+# measures the real crossover and this constant + the table in
+# docs/perf_attention.md get set from that run.
 FLASH_MIN_SEQ = 256
 
 

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ggrmcp_tpu.models import common
 from ggrmcp_tpu.models import llama as llama_mod
+from ggrmcp_tpu.ops import quant
 from ggrmcp_tpu.parallel import mesh as mesh_mod
 
 
@@ -229,7 +230,10 @@ def cache_specs_pp() -> llama_mod.KVCache:
 
 def _run_block_cached(layers_local, x, cfg, positions, ck, cv, clen, fam):
     """Scan this stage's local layer block threading its cache block.
-    ck/cv: [L/S, mb, S_max, KVH, D] for the current microbatch's rows."""
+    ck/cv: [L/S, mb, S_max, KVH, D] for the current microbatch's rows —
+    dense arrays or QuantizedArray (int8 KV) pytrees; scan slices the
+    leading layer axis of every leaf either way, and the family layer
+    handles quantized cache blocks natively (llama.attention_block)."""
 
     def body(h, scanned):
         lp, k_layer, v_layer = scanned
@@ -334,18 +338,27 @@ def _pipelined_cached(
         pos = jax.lax.dynamic_index_in_dim(pos_mb, m, 0, keepdims=False)
         clen = jax.lax.dynamic_index_in_dim(clen_mb, m, 0, keepdims=False)
         row0 = m * mb
-        ck_m = jax.lax.dynamic_slice_in_dim(ck, row0, mb, axis=1)
-        cv_m = jax.lax.dynamic_slice_in_dim(cv, row0, mb, axis=1)
+        # kv_map: cache blocks may be QuantizedArray (int8 KV) — every
+        # bookkeeping op indexes leading axes only, so it applies to
+        # values and scales identically (ops/quant.py).
+        ck_m = quant.kv_map(
+            lambda c: jax.lax.dynamic_slice_in_dim(c, row0, mb, axis=1), ck
+        )
+        cv_m = quant.kv_map(
+            lambda c: jax.lax.dynamic_slice_in_dim(c, row0, mb, axis=1), cv
+        )
         y, ck2_m, cv2_m = _run_block_cached(
             layers_local, state, cfg, pos, ck_m, cv_m, clen, fam
         )
         live = (t - stage >= 0) & (t - stage < M)
-        ck = jax.lax.dynamic_update_slice_in_dim(
-            ck, jnp.where(live, ck2_m, ck_m), row0, axis=1
-        )
-        cv = jax.lax.dynamic_update_slice_in_dim(
-            cv, jnp.where(live, cv2_m, cv_m), row0, axis=1
-        )
+
+        def commit(c, new, old):
+            return jax.lax.dynamic_update_slice_in_dim(
+                c, jnp.where(live, new, old), row0, axis=1
+            )
+
+        ck = quant.kv_map(commit, ck, ck2_m, ck_m)
+        cv = quant.kv_map(commit, cv, cv2_m, cv_m)
         m_out = t - (S - 1)
         upd = jax.lax.dynamic_update_index_in_dim(
             out, y, jnp.clip(m_out, 0, M - 1), 0

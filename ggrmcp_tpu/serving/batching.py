@@ -188,6 +188,7 @@ class ContinuousBatcher:
         # and without donation every hit would allocate + copy a dead
         # full-size [1, S_max] KV row.
         self._pfx_store = jax.jit(self._pfx_store_impl)
+        self._pfx_store_slot = jax.jit(self._pfx_store_slot_impl)
         self._pfx_load = jax.jit(self._pfx_load_impl, donate_argnums=(0,))
 
     def _make_mini(self, rows: int, length: int):
@@ -308,6 +309,24 @@ class ContinuousBatcher:
         )
         return _merge_row(pool, clipped, entry, plen)
 
+    def _pfx_store_slot_impl(self, pool, cache, slot, entry, plen):
+        """_pfx_store from a SHARED-cache row instead of an admission
+        mini (burst learning): slice slot's row out of the pool-width
+        head of the cache and merge it into pool entry `entry`. Prefix
+        KV depends only on prefix tokens (causal), so any admitted row
+        holding the prefix is a valid source."""
+        m = self._pfx_max
+
+        def pick(c):
+            return jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1)[:, :, :m]
+
+        row = llama_mod.KVCache(
+            k=quant.kv_map(pick, cache.k),
+            v=quant.kv_map(pick, cache.v),
+            length=jnp.full((1,), plen, jnp.int32),
+        )
+        return _merge_row(pool, row, entry, plen)
+
     def _pfx_load_impl(self, mini, pool, entry, plen):
         """Write pool entry `entry` into a fresh mini cache's head and
         set its length to the prefix length: the chunked prefill then
@@ -406,17 +425,22 @@ class ContinuousBatcher:
         return np.asarray(prompt[:plen], np.int32)
 
     def _pfx_insert(self, mini, key: np.ndarray) -> None:
-        """Pool `key`'s KV out of a fully prefilled mini row, evicting
-        the LRU entry and any entry the new key subsumes. A device
-        failure only skips the caching (the pool is never donated)."""
+        """Pool `key`'s KV out of a fully prefilled mini row."""
+        self._pfx_commit(key, lambda entry: self._pfx_store(
+            self._pfx_pool, mini, jnp.int32(entry), jnp.int32(len(key))
+        ))
+
+    def _pfx_commit(self, key: np.ndarray, pool_fn) -> None:
+        """Shared insert bookkeeping: pick the entry (free, else LRU),
+        run `pool_fn(entry)` to produce the updated pool, evict any
+        entry the new key subsumes. A device failure only skips the
+        caching (the pool is never donated)."""
         free = [e for e, k in enumerate(self._pfx_keys) if k is None]
         entry = free[0] if free else min(
             range(len(self._pfx_keys)), key=lambda e: self._pfx_used[e]
         )
         try:
-            pool = self._pfx_store(
-                self._pfx_pool, mini, jnp.int32(entry), jnp.int32(len(key))
-            )
+            pool = pool_fn(entry)
             jax.block_until_ready(pool.length)
         except Exception:
             logger.exception("prefix-pool store failed; entry not cached")
@@ -434,6 +458,55 @@ class ContinuousBatcher:
                 # `key` extends `other`: the shorter entry can never
                 # out-match the new one again.
                 self._pfx_keys[e] = None
+
+    def _pfx_learn_from_burst(
+        self, slots_idx: list[int], batch: list[_Request]
+    ) -> None:
+        """A cold burst sharing a NEW poolable prefix must not leave
+        the pool empty (the exact agentic arrival pattern the pool
+        exists for: N sessions landing together with the same system
+        prompt). After a fused admission, pool the prefix shared by
+        the most rows, copied from one admitted row's cache slice —
+        one extra device call, only when at least two rows share it."""
+        if self._pfx_pool is None or len(batch) < 2:
+            return
+        prompts = [
+            np.asarray(r.prompt[: self._pfx_max + 1], np.int32)
+            for r in batch
+        ]
+        best: Optional[tuple[int, int, int]] = None  # (count, lcp, row)
+        for i in range(len(prompts)):
+            for j in range(i + 1, len(prompts)):
+                a, b = prompts[i], prompts[j]
+                # each sharer must keep ≥1 suffix token past the prefix
+                lcp = self._lcp(
+                    a, b, min(len(a) - 1, len(b) - 1, self._pfx_max)
+                )
+                if lcp < self._pfx_min:
+                    continue
+                key = a[:lcp]
+                count = sum(
+                    1 for p in prompts
+                    if len(p) > lcp and np.array_equal(p[:lcp], key)
+                )
+                cand = (count, lcp, i)
+                if best is None or cand[:2] > best[:2]:
+                    best = cand
+        if best is None:
+            return
+        _, lcp, row = best
+        key = prompts[row][:lcp]
+        for k in self._pfx_keys:
+            if (
+                k is not None and len(k) >= lcp
+                and self._lcp(k, key, lcp) == lcp
+            ):
+                return  # an existing entry already covers this prefix
+        slot = slots_idx[row]
+        self._pfx_commit(key, lambda entry: self._pfx_store_slot(
+            self._pfx_pool, self.cache, jnp.int32(slot),
+            jnp.int32(entry), jnp.int32(lcp),
+        ))
 
     def _prefill_chunked(
         self,
@@ -584,6 +657,13 @@ class ContinuousBatcher:
                 # never match a lookup.
                 self._pfx_pool = self._pfx_store(
                     self._pfx_pool, mini, jnp.int32(0), jnp.int32(0)
+                )
+                # Burst learning stores from a shared-cache row — warm
+                # that program too (same never-matches plen=0 entry),
+                # or the first cold burst pays its compile inline.
+                self._pfx_pool = self._pfx_store_slot(
+                    self._pfx_pool, self.cache, jnp.int32(0),
+                    jnp.int32(0), jnp.int32(0),
                 )
                 # _pfx_load donates its mini: keep the returned one.
                 mini = self._pfx_load(
@@ -819,8 +899,9 @@ class ContinuousBatcher:
                 # the chunked path (whose mini cache feeds the pool
                 # store) only on trickle admissions — a burst of
                 # distinct prompts stays ONE fused device call instead
-                # of N serial chunked ones, at the cost of not learning
-                # prefixes from bursts.
+                # of N serial chunked ones; shared prefixes in a burst
+                # are learned AFTER the fused call from one admitted
+                # row's cache slice (_pfx_learn_from_burst).
                 trickle and self._pfx_storable(req.prompt) is not None
             ):
                 self._prefill_chunked(sl, req)
@@ -846,6 +927,9 @@ class ContinuousBatcher:
             # prefill (compute scales with rows; round-trips are ~equal).
             for slot_idx, req in zip(slots_idx, batch):
                 self._prefill_fused([slot_idx], [req])
+            # Both rows are in the shared cache now — a pair arriving
+            # together with the same NEW preamble must learn it too.
+            self._pfx_learn_from_burst(slots_idx, batch)
             return
         row_of = (lambda j: 0) if single else (lambda j: slots_idx[j])
         tokens = np.zeros((rows, s), np.int32)
@@ -886,6 +970,8 @@ class ContinuousBatcher:
         self._cache_at_risk = False
         for j, (slot_idx, req) in enumerate(zip(slots_idx, batch)):
             self._activate_slot(slot_idx, req, int(first[row_of(j)]))
+        if not single:
+            self._pfx_learn_from_burst(slots_idx, batch)
 
     def _tick_sync(self) -> None:
         step0 = self.step_counter

@@ -171,14 +171,10 @@ class GenerationEngine:
         self._init_pp_serving()
         if self.pp_serving and self.ring_capacity:
             raise ValueError("kv_ring is not supported under pp serving")
-        if self.pp_serving and self.kv_dtype:
-            # Same rule as config.validate (kept here too: engines are
-            # constructible without a full Config, e.g. in tests).
-            raise ValueError(
-                "kv_cache_dtype='int8' is not supported under "
-                "pipeline-parallel serving (the staged forward manages "
-                "its own cache layout)"
-            )
+        # int8 KV composes with PP serving: the staged forward's cache
+        # bookkeeping goes through quant.kv_map, so QuantizedArray K/V
+        # leaves thread the tick schedule like dense ones
+        # (parallel/pipeline.py::_pipelined_cached).
         param_specs = (
             self._pp.param_specs_pp(cfg) if self.pp_serving
             else self.fam.param_specs(cfg)

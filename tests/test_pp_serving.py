@@ -176,3 +176,90 @@ class TestPPValidation:
                 ),
                 mesh=mesh,
             )
+
+
+class TestPPInt8KV:
+    """int8 KV under PP serving (VERDICT r2 #5): the staged forward
+    threads QuantizedArray K/V leaves through its tick schedule via
+    quant.kv_map — the serve-a-model-bigger-than-a-slice path no longer
+    forces bf16 KV."""
+
+    def test_staged_prefill_matches_plain_forward_int8(self, pp_mesh):
+        from functools import partial
+
+        from ggrmcp_tpu.ops.quant import dequantize
+
+        params = llama.init_params(jax.random.PRNGKey(0), CFG)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 32), 0, CFG.vocab_size
+        ).astype(np.int32)
+        cache_a = llama.KVCache.create(CFG, 4, 64, "int8")
+        cache_b = llama.KVCache.create(CFG, 4, 64, "int8")
+        ref_logits, ref_cache = llama.forward(params, CFG, tokens, cache_a)
+        pp_logits, pp_cache = jax.jit(
+            partial(pipeline_forward_cached, cfg=CFG, mesh=pp_mesh)
+        )(params, tokens=tokens, cache=cache_b)
+        np.testing.assert_allclose(
+            np.asarray(pp_logits), np.asarray(ref_logits),
+            atol=2e-3, rtol=2e-3,
+        )
+        # caches must agree after dequantization (tensor-sharded
+        # matmuls may flip a rounding ulp, so not exact-int8 equality)
+        np.testing.assert_allclose(
+            np.asarray(dequantize(pp_cache.k)),
+            np.asarray(dequantize(ref_cache.k)),
+            atol=2e-2, rtol=2e-2,
+        )
+        assert np.array_equal(
+            np.asarray(pp_cache.length), np.asarray(ref_cache.length)
+        )
+
+    def test_int8_kv_greedy_matches_single_device(self, pp_mesh):
+        pp_eng = GenerationEngine(
+            CFG,
+            ServingConfig(
+                model="tiny-llama",
+                mesh=MeshConfig(stage=2, tensor=2, data=0),
+                kv_cache_dtype="int8",
+            ),
+            mesh=pp_mesh,
+        )
+        ref = GenerationEngine(
+            CFG,
+            ServingConfig(model="tiny-llama", kv_cache_dtype="int8"),
+            mesh=mesh_mod.build_mesh(MeshConfig(tensor=1), jax.devices()[:1]),
+        )
+        prompts = [[3, 1, 4, 1, 5], [9, 2, 6, 5, 3, 5]]
+        pp_out, pp_reasons = pp_eng.generate(prompts, max_new_tokens=8, seed=0)
+        ref_out, ref_reasons = ref.generate(prompts, max_new_tokens=8, seed=0)
+        assert pp_out == ref_out
+        assert pp_reasons == ref_reasons
+
+    async def test_batcher_int8_kv_on_pp_mesh(self, pp_mesh):
+        from ggrmcp_tpu.serving.batching import ContinuousBatcher
+
+        eng = GenerationEngine(
+            CFG,
+            ServingConfig(
+                model="tiny-llama",
+                mesh=MeshConfig(stage=2, tensor=2, data=0),
+                kv_cache_dtype="int8",
+            ),
+            mesh=pp_mesh,
+        )
+        batcher = ContinuousBatcher(
+            eng, BatchingConfig(max_batch_size=4, max_queue_delay_ms=2.0)
+        )
+        batcher.start()
+        try:
+            ids: list[int] = []
+            reason = None
+            async for chunk, r in batcher.submit(
+                [5, 3, 8], 6, SamplingConfig(), seed=0
+            ):
+                ids.extend(chunk)
+                reason = r
+            assert reason in ("stop", "length")
+            assert 0 < len(ids) <= 6
+        finally:
+            await batcher.stop()
