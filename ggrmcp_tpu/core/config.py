@@ -70,6 +70,11 @@ class ServerConfig:
     # worker; use 1 worker or a sticky LB if cross-connection session
     # continuity matters). Requires a fixed port.
     workers: int = 1
+    # HTTP server implementation: "fastlane" (raw asyncio.Protocol hot
+    # path, gateway/fastlane.py — the default; ~framework-free
+    # per-request cost) or "aiohttp" (the web.Application stack).
+    # Identical served surface and gate semantics either way.
+    http_impl: str = "fastlane"
     allowed_content_types: list[str] = field(
         default_factory=lambda: ["application/json"]
     )
@@ -416,6 +421,11 @@ class Config:
             raise ValueError(f"invalid HTTP port: {self.server.port}")
         if self.server.workers < 1:
             raise ValueError("server.workers must be >= 1")
+        if self.server.http_impl not in ("fastlane", "aiohttp"):
+            raise ValueError(
+                f"unknown server.http_impl {self.server.http_impl!r}; "
+                "supported: 'fastlane', 'aiohttp'"
+            )
         if not (0 < self.grpc.port < 65536):
             raise ValueError(f"invalid gRPC port: {self.grpc.port}")
         if self.server.request_timeout_s <= 0:

@@ -48,9 +48,13 @@ class Gateway:
             targets if targets is not None else [cfg.grpc.target], cfg.grpc
         )
         self.handler = MCPHandler(cfg, self.discoverer, self.sessions, self.metrics)
-        self.app = self._build_app()
+        # The aiohttp app (routes + middleware) is only built when that
+        # implementation actually serves (start()); the fastlane default
+        # doesn't pay for it.
+        self.app: Optional[web.Application] = None
         self._runner: Optional[web.AppRunner] = None
         self._site: Optional[web.TCPSite] = None
+        self._fastlane = None
         self.port = cfg.server.port
 
     def _build_app(self) -> web.Application:
@@ -83,30 +87,48 @@ class Gateway:
         await self.discoverer.discover_services()
         self.discoverer.start_watchdog()
 
-        # access_log=None: the fused middleware already logs requests;
-        # aiohttp's default access logger would format+emit a second
-        # line per request on the hot path.
-        self._runner = web.AppRunner(self.app, access_log=None)
-        await self._runner.setup()
-        self._site = web.TCPSite(
-            self._runner, self.cfg.server.host, self.cfg.server.port,
-            reuse_port=reuse_port or None,
-        )
-        await self._site.start()
-        for s in self._runner.sites:
-            # resolve the real port when configured with 0
-            sock = s._server.sockets[0] if s._server and s._server.sockets else None
-            if sock is not None:
-                self.port = sock.getsockname()[1]
+        if self.cfg.server.http_impl == "fastlane":
+            from ggrmcp_tpu.gateway.fastlane import FastLaneServer
+
+            self._fastlane = FastLaneServer(self.cfg, self.handler)
+            await self._fastlane.start(
+                self.cfg.server.host, self.cfg.server.port,
+                reuse_port=reuse_port,
+            )
+            self.port = self._fastlane.port
+        else:
+            if self.app is None:
+                self.app = self._build_app()
+            # access_log=None: the fused middleware already logs requests;
+            # aiohttp's default access logger would format+emit a second
+            # line per request on the hot path.
+            self._runner = web.AppRunner(self.app, access_log=None)
+            await self._runner.setup()
+            self._site = web.TCPSite(
+                self._runner, self.cfg.server.host, self.cfg.server.port,
+                reuse_port=reuse_port or None,
+            )
+            await self._site.start()
+            for s in self._runner.sites:
+                # resolve the real port when configured with 0
+                sock = s._server.sockets[0] if s._server and s._server.sockets else None
+                if sock is not None:
+                    self.port = sock.getsockname()[1]
         logger.info(
-            "gateway listening on %s:%d (%d tools)",
+            "gateway listening on %s:%d (%d tools, %s)",
             self.cfg.server.host, self.port,
             self.discoverer.get_service_stats()["methodCount"],
+            self.cfg.server.http_impl,
         )
 
     async def stop(self) -> None:
         """Graceful shutdown with drain (main.go:94-112)."""
         await self.discoverer.stop_watchdog()
+        if self._fastlane is not None:
+            await asyncio.wait_for(
+                self._fastlane.stop(), timeout=self.cfg.server.shutdown_grace_s
+            )
+            self._fastlane = None
         if self._runner is not None:
             await asyncio.wait_for(
                 self._runner.cleanup(), timeout=self.cfg.server.shutdown_grace_s

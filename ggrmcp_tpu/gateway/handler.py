@@ -48,6 +48,50 @@ SESSION_HEADER = "Mcp-Session-Id"
 TRACE_RESPONSE_HEADER = "X-Trace-Id"
 
 
+class SSETransport:
+    """How `MCPHandler._stream_tool_call` writes an event stream,
+    independent of the HTTP server implementation. `start` opens the
+    stream (headers out), `event` writes one SSE event, `close` ends
+    the stream. Implementations: `_AiohttpSSE` here, `_RawSSE` in
+    gateway/fastlane.py."""
+
+    async def start(self, session_id: str, trace_id: str) -> None:
+        raise NotImplementedError
+
+    async def event(self, event: str, data: Any) -> None:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        raise NotImplementedError
+
+
+class _AiohttpSSE(SSETransport):
+    def __init__(self, request: web.Request):
+        self._request = request
+        self.response: Optional[web.StreamResponse] = None
+
+    async def start(self, session_id: str, trace_id: str) -> None:
+        self.response = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                SESSION_HEADER: session_id,
+                TRACE_RESPONSE_HEADER: trace_id,
+            },
+        )
+        await self.response.prepare(self._request)
+
+    async def event(self, event: str, data: Any) -> None:
+        payload = json.dumps(data, ensure_ascii=False)
+        await self.response.write(
+            f"event: {event}\ndata: {payload}\n\n".encode()
+        )
+
+    async def close(self) -> None:
+        await self.response.write_eof()
+
+
 class MCPHandler:
     def __init__(
         self,
@@ -81,7 +125,9 @@ class MCPHandler:
         return response
 
     async def handle_post(self, request: web.Request) -> web.StreamResponse:
-        """POST / → JSON-RPC dispatch (handler.go:81-157)."""
+        """POST / → JSON-RPC dispatch (handler.go:81-157): the aiohttp
+        wrapper over the transport-agnostic `dispatch` core (shared with
+        the raw-protocol fast lane, gateway/fastlane.py)."""
         try:
             body = await request.read()
             data = json.loads(body)
@@ -99,6 +145,39 @@ class MCPHandler:
             logger.debug("notification: %s", method)
             return web.Response(status=202)
 
+        sse = (
+            _AiohttpSSE(request) if self._wants_sse(request) else None
+        )
+        resp_dict, session, trace_id = await self.dispatch(
+            data,
+            lambda: self._session_for(request),
+            trace_id_in=request.headers.get(tracing.TRACE_HEADER),
+            sse=sse,
+        )
+        if resp_dict is None and sse is not None and sse.response is not None:
+            return sse.response  # streamed; final event already written
+        response = web.json_response(resp_dict)
+        if session is not None:
+            response.headers[SESSION_HEADER] = session.id
+        if trace_id is not None:
+            response.headers[TRACE_RESPONSE_HEADER] = trace_id
+        return response
+
+    async def dispatch(
+        self,
+        data: Any,
+        get_session: Any,
+        trace_id_in: Optional[str] = None,
+        sse: Optional["SSETransport"] = None,
+    ) -> tuple[Optional[dict[str, Any]], Optional[SessionContext], Optional[str]]:
+        """Transport-agnostic JSON-RPC dispatch.
+
+        `data` is the decoded request (the caller handles parse errors
+        and notifications — they need the raw body). `get_session` is
+        called lazily so an invalid request never mints a session.
+        Returns `(response_dict, session, trace_id)`; `response_dict`
+        is None when the response was streamed through `sse`.
+        """
         request_id = data.get("id") if isinstance(data, dict) else None
         try:
             self.validator.validate_request(data)
@@ -107,30 +186,42 @@ class MCPHandler:
                 data.get("method", "?") if isinstance(data, dict) else "?",
                 "invalid",
             )
-            return web.json_response(
-                mcp.make_error_response(request_id, exc.code, exc.message, exc.data)
+            return (
+                mcp.make_error_response(
+                    request_id, exc.code, exc.message, exc.data
+                ),
+                None,
+                None,
             )
 
-        session = self._session_for(request)
+        session = get_session()
         method = data["method"]
         params = data.get("params")
 
         # Enforced session policy (the reference defined but never called
         # these — manager.go:178).
         if session.blocked:
-            return self._error(
-                request_id, session, mcp.INVALID_REQUEST, "session is blocked"
+            return (
+                mcp.make_error_response(
+                    request_id, mcp.INVALID_REQUEST, "session is blocked"
+                ),
+                session,
+                None,
             )
         if not self.sessions.check_rate_limit(session):
             self.metrics.rate_limit_hit("session")
-            return self._error(
-                request_id, session, mcp.INVALID_REQUEST,
-                "session rate limit exceeded",
+            return (
+                mcp.make_error_response(
+                    request_id, mcp.INVALID_REQUEST,
+                    "session rate limit exceeded",
+                ),
+                session,
+                None,
             )
 
         # One span per request; the incoming x-trace-id header (if any)
         # continues the caller's trace, and the id is echoed back.
-        trace_id = request.headers.get(tracing.TRACE_HEADER) or tracing.new_id()
+        trace_id = trace_id_in or tracing.new_id()
         try:
             with tracing.tracer.span(
                 f"gateway.{method}", trace_id=trace_id, session=session.id[:8]
@@ -142,14 +233,12 @@ class MCPHandler:
                 elif method == "tools/list":
                     result = self._handle_tools_list()
                 elif method == "tools/call":
-                    if self._wants_sse(request):
-                        response = await self._handle_tools_call_sse(
-                            request, request_id, session, params
+                    if sse is not None:
+                        await self._stream_tool_call(
+                            request_id, session, params, sse, trace_id
                         )
-                        return response
-                    result = await self._handle_tools_call(
-                        request, session, params
-                    )
+                        return None, session, trace_id
+                    result = await self._handle_tools_call(session, params)
                 elif method == "prompts/list":
                     result = {"prompts": []}
                 elif method == "resources/list":
@@ -159,23 +248,26 @@ class MCPHandler:
                         mcp.METHOD_NOT_FOUND, f"method not found: {method}"
                     )
             self.metrics.observe_rpc(method, "ok")
-            response = web.json_response(mcp.make_response(request_id, result))
+            return mcp.make_response(request_id, result), session, trace_id
         except mcp.MCPError as exc:
             self.metrics.observe_rpc(method, "error")
-            response = web.json_response(
-                mcp.make_error_response(request_id, exc.code, exc.message, exc.data)
+            return (
+                mcp.make_error_response(
+                    request_id, exc.code, exc.message, exc.data
+                ),
+                session,
+                trace_id,
             )
         except Exception as exc:  # unexpected → internal error, sanitized
             logger.exception("internal error handling %s", method)
             self.metrics.observe_rpc(method, "internal_error")
-            response = web.json_response(
+            return (
                 mcp.make_error_response(
                     request_id, mcp.INTERNAL_ERROR, sanitize_error(str(exc))
-                )
+                ),
+                session,
+                trace_id,
             )
-        response.headers[SESSION_HEADER] = session.id
-        response.headers[TRACE_RESPONSE_HEADER] = trace_id
-        return response
 
     # ------------------------------------------------------------------
     # Method handlers
@@ -195,7 +287,6 @@ class MCPHandler:
 
     async def _handle_tools_call(
         self,
-        request: web.Request,
         session: SessionContext,
         params: Any,
     ) -> dict[str, Any]:
@@ -270,27 +361,21 @@ class MCPHandler:
         accept = request.headers.get("Accept", "")
         return "text/event-stream" in accept
 
-    async def _handle_tools_call_sse(
+    async def _stream_tool_call(
         self,
-        request: web.Request,
         request_id: Any,
         session: SessionContext,
         params: Any,
-    ) -> web.StreamResponse:
+        sse: "SSETransport",
+        trace_id: str,
+    ) -> None:
         """Stream tool output incrementally as SSE events; the final
-        event carries the complete JSON-RPC response."""
+        event carries the complete JSON-RPC response. Transport-agnostic:
+        `sse` opens the stream and writes events (aiohttp StreamResponse
+        or the fast lane's raw socket writer)."""
         tool_name, arguments = self.validator.validate_tool_call_params(params)
         headers = self._metadata_with_trace(session)
-        response = web.StreamResponse(
-            status=200,
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-                SESSION_HEADER: session.id,
-                TRACE_RESPONSE_HEADER: tracing.tracer.current_trace_id(),
-            },
-        )
-        await response.prepare(request)
+        await sse.start(session.id, trace_id)
         start = time.perf_counter()
         chunks: list[dict[str, Any]] = []
         outcome = "ok"
@@ -299,8 +384,7 @@ class MCPHandler:
                 tool_name, arguments, headers, self.cfg.server.request_timeout_s
             ):
                 chunks.append(chunk)
-                await self._sse_event(
-                    response,
+                await sse.event(
                     "chunk",
                     {"content": mcp.text_content(json.dumps(chunk, ensure_ascii=False))},
                 )
@@ -320,7 +404,7 @@ class MCPHandler:
             self.metrics.observe_tool_call(
                 tool_name, "client_disconnect", time.perf_counter() - start
             )
-            return response
+            return
         except ConnectionError as exc:
             # Same outcome label as the unary path, so per-outcome
             # dashboards agree across transports.
@@ -348,24 +432,19 @@ class MCPHandler:
             tool_name, outcome, time.perf_counter() - start
         )
         try:
-            await self._sse_event(response, "result", final)
-            await response.write_eof()
+            await sse.event("result", final)
+            await sse.close()
         except (ConnectionResetError, ConnectionAbortedError):
             pass  # client vanished before the final event
-        return response
-
-    @staticmethod
-    async def _sse_event(response: web.StreamResponse, event: str, data: Any):
-        payload = json.dumps(data, ensure_ascii=False)
-        await response.write(f"event: {event}\ndata: {payload}\n\n".encode())
 
     # ------------------------------------------------------------------
     # Health / metrics / stats endpoints
     # ------------------------------------------------------------------
 
-    async def handle_health(self, request: web.Request) -> web.Response:
-        """GET /health (handler.go:331-364): deep backend check + tool
-        count; 503 when degraded."""
+    async def health_body(self) -> tuple[dict[str, Any], int]:
+        """GET /health core (handler.go:331-364): deep backend check +
+        tool count; 503 when degraded. Framework-free — shared by the
+        aiohttp handler and the fast lane."""
         try:
             healthy = await asyncio.wait_for(
                 self.discoverer.health_check(), timeout=5.0
@@ -380,11 +459,14 @@ class MCPHandler:
             "methodCount": stats["methodCount"],
             "sessions": self.sessions.count(),
         }
-        status = 200 if body["status"] == "healthy" else 503
+        return body, 200 if body["status"] == "healthy" else 503
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        body, status = await self.health_body()
         return web.json_response(body, status=status)
 
-    async def handle_metrics(self, request: web.Request) -> web.Response:
-        """GET /metrics: Prometheus text exposition (replacing the
+    async def metrics_body(self) -> tuple[bytes, str]:
+        """GET /metrics core: Prometheus text exposition (replacing the
         reference's JSON stub)."""
         stats = self.discoverer.get_service_stats()
         healthy_backends = sum(1 for b in stats["backends"] if b["healthy"])
@@ -395,27 +477,37 @@ class MCPHandler:
             await self.discoverer.get_serving_stats_snapshot()
         )
         payload, content_type = self.metrics.render()
-        return web.Response(body=payload, content_type=content_type.split(";")[0])
+        return payload, content_type.split(";")[0]
 
-    async def handle_stats(self, request: web.Request) -> web.Response:
-        """GET /stats: the reference's JSON stats dump, kept for parity
-        (handler.go:367-376)."""
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        payload, content_type = await self.metrics_body()
+        return web.Response(body=payload, content_type=content_type)
+
+    async def stats_body(self) -> dict[str, Any]:
+        """GET /stats core: the reference's JSON stats dump, kept for
+        parity (handler.go:367-376)."""
         stats = self.discoverer.get_service_stats()
         stats["sessions"] = self.sessions.stats()
         serving = await self.discoverer.get_backend_serving_stats()
         if serving:
             stats["serving"] = serving
-        return web.json_response(stats)
+        return stats
 
-    async def handle_traces(self, request: web.Request) -> web.Response:
-        """GET /debug/traces: recent per-call spans, newest first
+    async def handle_stats(self, request: web.Request) -> web.Response:
+        return web.json_response(await self.stats_body())
+
+    def traces_body(self, n_raw: str) -> dict[str, Any]:
+        """GET /debug/traces core: recent per-call spans, newest first
         (SURVEY.md §5.1 — the reference had durations in logs only)."""
         try:
-            n = int(request.query.get("n", "100"))
+            n = int(n_raw)
         except ValueError:
             n = 100
+        return {"spans": tracing.tracer.recent(max(1, min(n, 512)))}
+
+    async def handle_traces(self, request: web.Request) -> web.Response:
         return web.json_response(
-            {"spans": tracing.tracer.recent(max(1, min(n, 512)))}
+            self.traces_body(request.query.get("n", "100"))
         )
 
     # ------------------------------------------------------------------
@@ -451,15 +543,3 @@ class MCPHandler:
             raw_headers[key] = values[0] if len(values) == 1 else list(values)
         return self.sessions.get_or_create(sid, raw_headers)
 
-    def _error(
-        self,
-        request_id: Any,
-        session: SessionContext,
-        code: int,
-        message: str,
-    ) -> web.Response:
-        response = web.json_response(
-            mcp.make_error_response(request_id, code, message)
-        )
-        response.headers[SESSION_HEADER] = session.id
-        return response

@@ -153,14 +153,26 @@ class ReflectionService:
         async for request in request_iterator:
             yield self._handle(request)
 
-    def attach(self, server: grpc.aio.Server) -> None:
+    def server_reflection_info_sync(self, request_iterator, context):
+        for request in request_iterator:
+            yield self._handle(request)
+
+    def attach(self, server: grpc.aio.Server, sync: bool = False) -> None:
+        """`sync=True` registers thread-pool handlers for a `grpc.server`
+        (the registration API is identical; only the handler callables
+        differ). Sync servers keep a trivial backend's per-call Python
+        cost off the asyncio path — see examples/hello_server.py."""
+        handler = (
+            self.server_reflection_info_sync if sync
+            else self.server_reflection_info
+        )
         for package in ("grpc.reflection.v1alpha", "grpc.reflection.v1"):
             add_service(
                 server,
                 f"{package}.ServerReflection",
                 {
                     "ServerReflectionInfo": MethodDef(
-                        self.server_reflection_info,
+                        handler,
                         reflection_pb2.ServerReflectionRequest,
                         reflection_pb2.ServerReflectionResponse,
                         server_streaming=True,
@@ -200,18 +212,32 @@ class HealthService:
         )
         yield health_pb2.HealthCheckResponse(status=status)
 
-    def attach(self, server: grpc.aio.Server) -> None:
+    def check_sync(self, request: health_pb2.HealthCheckRequest, context):
+        status = self._status.get(request.service)
+        if status is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")
+        return health_pb2.HealthCheckResponse(status=status)
+
+    def watch_sync(self, request: health_pb2.HealthCheckRequest, context):
+        yield health_pb2.HealthCheckResponse(
+            status=self._status.get(
+                request.service,
+                health_pb2.HealthCheckResponse.SERVICE_UNKNOWN,
+            )
+        )
+
+    def attach(self, server: grpc.aio.Server, sync: bool = False) -> None:
         add_service(
             server,
             "grpc.health.v1.Health",
             {
                 "Check": MethodDef(
-                    self.check,
+                    self.check_sync if sync else self.check,
                     health_pb2.HealthCheckRequest,
                     health_pb2.HealthCheckResponse,
                 ),
                 "Watch": MethodDef(
-                    self.watch,
+                    self.watch_sync if sync else self.watch,
                     health_pb2.HealthCheckRequest,
                     health_pb2.HealthCheckResponse,
                     server_streaming=True,

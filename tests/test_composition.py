@@ -111,3 +111,9 @@ class TestMaximalSidecar:
         finally:
             await channel.close()
             await side.stop()
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+import pytest  # noqa: E402  (slow-mark only)
+pytestmark = pytest.mark.slow

@@ -1,0 +1,279 @@
+"""Raw-protocol edges of the fastlane HTTP server (gateway/fastlane.py).
+
+The full gateway suite (test_gateway_http.py etc.) already runs against
+the fastlane — it is the default `server.http_impl`. These tests cover
+what an aiohttp client can't produce: hand-written wire bytes
+(pipelining, malformed framing, chunked uploads, oversized heads,
+Connection semantics) — plus a smoke pass proving the aiohttp fallback
+implementation still serves the same surface.
+"""
+
+import asyncio
+import json
+
+from tests.test_gateway_http import gateway_config, gateway_env
+
+
+async def raw_conn(gw):
+    return await asyncio.open_connection("127.0.0.1", gw.port)
+
+
+async def read_response(reader) -> tuple[int, dict[str, str], bytes]:
+    status_line = await reader.readline()
+    status = int(status_line.split(b" ")[1])
+    headers: dict[str, str] = {}
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b""):
+            break
+        k, _, v = line.decode().partition(":")
+        headers[k.strip().lower()] = v.strip()
+    body = b""
+    if "content-length" in headers:
+        body = await reader.readexactly(int(headers["content-length"]))
+    return status, headers, body
+
+
+def post_bytes(body: bytes, extra: bytes = b"") -> bytes:
+    return (
+        b"POST / HTTP/1.1\r\nHost: t\r\nContent-Type: application/json\r\n"
+        + extra
+        + b"Content-Length: %d\r\n\r\n" % len(body)
+        + body
+    )
+
+
+def rpc_bytes(method: str, id_: int, params=None, extra: bytes = b"") -> bytes:
+    body = {"jsonrpc": "2.0", "method": method, "id": id_}
+    if params is not None:
+        body["params"] = params
+    return post_bytes(json.dumps(body).encode(), extra)
+
+
+class TestWire:
+    async def test_keepalive_sequential_and_pipelined(self):
+        async with gateway_env() as (_, gw, _client):
+            reader, writer = await raw_conn(gw)
+            # sequential on one connection
+            writer.write(rpc_bytes("ping", 1))
+            await writer.drain()
+            status, headers, body = await read_response(reader)
+            assert status == 200
+            assert json.loads(body)["id"] == 1
+            sid = headers["mcp-session-id"]
+            # two pipelined requests in ONE write; responses in order
+            writer.write(
+                rpc_bytes("ping", 2) + rpc_bytes("tools/list", 3)
+            )
+            await writer.drain()
+            s2, h2, b2 = await read_response(reader)
+            s3, _h3, b3 = await read_response(reader)
+            assert (s2, s3) == (200, 200)
+            assert json.loads(b2)["id"] == 2
+            assert json.loads(b3)["id"] == 3
+            # the keep-alive connection reuses the minted session
+            assert h2["mcp-session-id"] != ""
+            assert sid  # first response minted one
+            writer.close()
+            await writer.wait_closed()
+
+    async def test_split_delivery_reassembled(self):
+        """A request arriving byte-dribbled across TCP segments still
+        parses (head and body straddle arbitrary boundaries)."""
+        async with gateway_env() as (_, gw, _client):
+            reader, writer = await raw_conn(gw)
+            payload = rpc_bytes("ping", 9)
+            for i in range(0, len(payload), 7):
+                writer.write(payload[i : i + 7])
+                await writer.drain()
+            status, _h, body = await read_response(reader)
+            assert status == 200
+            assert json.loads(body)["id"] == 9
+            writer.close()
+            await writer.wait_closed()
+
+    async def test_connection_close_honored(self):
+        async with gateway_env() as (_, gw, _client):
+            reader, writer = await raw_conn(gw)
+            writer.write(rpc_bytes("ping", 1, extra=b"Connection: close\r\n"))
+            await writer.drain()
+            status, _h, _b = await read_response(reader)
+            assert status == 200
+            assert await reader.read() == b""  # server closed
+            writer.close()
+            await writer.wait_closed()
+
+    async def test_http10_closes(self):
+        async with gateway_env() as (_, gw, _client):
+            reader, writer = await raw_conn(gw)
+            writer.write(
+                b"GET /health HTTP/1.0\r\nHost: t\r\n\r\n"
+            )
+            await writer.drain()
+            status, _h, body = await read_response(reader)
+            assert status in (200, 503)
+            assert await reader.read() == b""
+            writer.close()
+            await writer.wait_closed()
+
+    async def test_chunked_upload_rejected_411(self):
+        async with gateway_env() as (_, gw, _client):
+            reader, writer = await raw_conn(gw)
+            writer.write(
+                b"POST / HTTP/1.1\r\nHost: t\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+            )
+            await writer.drain()
+            status, _h, _b = await read_response(reader)
+            assert status == 411
+            writer.close()
+            await writer.wait_closed()
+
+    async def test_bad_request_line_400(self):
+        async with gateway_env() as (_, gw, _client):
+            reader, writer = await raw_conn(gw)
+            writer.write(b"NONSENSE\r\n\r\n")
+            await writer.drain()
+            status, _h, _b = await read_response(reader)
+            assert status == 400
+            writer.close()
+            await writer.wait_closed()
+
+    async def test_oversized_head_431(self):
+        async with gateway_env() as (_, gw, _client):
+            reader, writer = await raw_conn(gw)
+            writer.write(
+                b"GET / HTTP/1.1\r\nHost: t\r\nX-Pad: "
+                + b"x" * (40 * 1024)
+            )
+            await writer.drain()
+            status, _h, _b = await read_response(reader)
+            assert status == 431
+            writer.close()
+            await writer.wait_closed()
+
+    async def test_oversized_body_rejected_before_read(self):
+        cfg = gateway_config()
+        cfg.server.max_request_bytes = 256
+        async with gateway_env(cfg) as (_, gw, _client):
+            reader, writer = await raw_conn(gw)
+            writer.write(post_bytes(b"x" * 1024))
+            await writer.drain()
+            status, headers, _b = await read_response(reader)
+            assert status == 413
+            # protocol-level rejects still carry the security headers
+            # and land in the HTTP metrics (not invisible to dashboards)
+            assert headers.get("x-content-type-options") == "nosniff"
+            writer.close()
+            await writer.wait_closed()
+            payload, _ct = await gw.handler.metrics_body()
+            assert b'gateway_http_requests_total{code="413"' in payload or (
+                b"413" in payload
+            )
+
+    async def test_expect_100_continue(self):
+        async with gateway_env() as (_, gw, _client):
+            reader, writer = await raw_conn(gw)
+            body = json.dumps(
+                {"jsonrpc": "2.0", "method": "ping", "id": 5}
+            ).encode()
+            writer.write(
+                b"POST / HTTP/1.1\r\nHost: t\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Expect: 100-continue\r\n"
+                b"Content-Length: %d\r\n\r\n" % len(body)
+            )
+            await writer.drain()
+            interim = await reader.readline()
+            assert b"100 Continue" in interim
+            await reader.readline()  # blank line after the interim
+            writer.write(body)
+            await writer.drain()
+            status, _h, resp = await read_response(reader)
+            assert status == 200
+            assert json.loads(resp)["id"] == 5
+            writer.close()
+            await writer.wait_closed()
+
+    async def test_multivalue_headers_snapshotted(self):
+        """Two values of one header survive into the session snapshot
+        (the multi-value fix, core/sessions.py) through the raw parser."""
+        async with gateway_env() as (_, gw, _client):
+            reader, writer = await raw_conn(gw)
+            writer.write(
+                rpc_bytes(
+                    "ping", 1,
+                    extra=b"X-Tag: one\r\nX-Tag: two\r\n",
+                )
+            )
+            await writer.drain()
+            status, headers, _b = await read_response(reader)
+            assert status == 200
+            sess = gw.sessions.get_live(headers["mcp-session-id"])
+            assert sess is not None
+            assert sess.headers.get("x-tag") == ["one", "two"]
+            writer.close()
+            await writer.wait_closed()
+
+    async def test_unknown_path_404_wrong_method_405(self):
+        async with gateway_env() as (_, gw, _client):
+            reader, writer = await raw_conn(gw)
+            writer.write(b"GET /nope HTTP/1.1\r\nHost: t\r\n\r\n")
+            await writer.drain()
+            status, _h, _b = await read_response(reader)
+            assert status == 404
+            writer.write(
+                b"DELETE / HTTP/1.1\r\nHost: t\r\n\r\n"
+            )
+            await writer.drain()
+            status, _h, _b = await read_response(reader)
+            assert status == 405
+            writer.close()
+            await writer.wait_closed()
+
+
+class TestAiohttpFallback:
+    """`server.http_impl="aiohttp"` still serves the same surface."""
+
+    async def test_core_flows(self):
+        cfg = gateway_config()
+        cfg.server.http_impl = "aiohttp"
+        async with gateway_env(cfg) as (_, gw, client):
+            assert gw._fastlane is None  # really the aiohttp stack
+            resp = await client.get("/")
+            assert resp.status == 200
+            body = {
+                "jsonrpc": "2.0", "method": "tools/call", "id": 2,
+                "params": {
+                    "name": "hello_helloservice_sayhello",
+                    "arguments": {"name": "impl"},
+                },
+            }
+            resp = await client.post("/", json=body)
+            data = await resp.json()
+            assert not data["result"].get("isError", False)
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            assert b"gateway_" in await resp.read()
+
+    async def test_sse_parity(self):
+        cfg = gateway_config()
+        cfg.server.http_impl = "aiohttp"
+        async with gateway_env(cfg) as (_, _gw, client):
+            resp = await client.post(
+                "/",
+                json={
+                    "jsonrpc": "2.0", "method": "tools/call", "id": 7,
+                    "params": {
+                        "name": "complexdemo_streamservice_watch",
+                        "arguments": {"userId": "w"},
+                    },
+                },
+                headers={"Accept": "text/event-stream"},
+            )
+            assert resp.headers["Content-Type"].startswith("text/event-stream")
+            text = await resp.text()
+            events = [e for e in text.split("\n\n") if e.strip()]
+            assert sum(e.startswith("event: chunk") for e in events) == 3
+            assert sum(e.startswith("event: result") for e in events) == 1

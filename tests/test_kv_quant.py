@@ -81,12 +81,14 @@ class TestKVQuantNumerics:
         with pytest.raises(ValueError):
             cfg.validate()
 
-    def test_pp_combination_rejected(self):
+    def test_pp_combination_allowed(self):
+        """int8 KV composes with pipeline serving since the staged
+        forward threads QuantizedArray leaves (parallel/pipeline.py);
+        greedy parity is pinned in test_pp_serving.py::TestPPInt8KV."""
         cfg = cfgmod.default()
         cfg.serving.kv_cache_dtype = "int8"
         cfg.serving.mesh.stage = 2
-        with pytest.raises(ValueError):
-            cfg.validate()
+        cfg.validate()
 
 
 class TestKVQuantServing:

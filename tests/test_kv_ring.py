@@ -265,3 +265,8 @@ class TestRingEquivalence:
                 llama.KVCache.create(plain, 2, 24),
                 ring=True,
             )
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow

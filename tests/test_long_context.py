@@ -153,3 +153,8 @@ class TestRing8k:
             assert 0 < len(out) <= 4
         finally:
             await batcher.stop()
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow

@@ -304,3 +304,8 @@ class TestBert:
         }
         assert not np.allclose(outs["mean"], outs["cls"])
         assert not np.allclose(outs["mean"], outs["max"])
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow

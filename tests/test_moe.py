@@ -199,3 +199,8 @@ class TestEngineIntegration:
         assert all(r in ("stop", "length") for r in reasons)
         info = engine.model_info()
         assert info["model_id"] == "tiny-moe"
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow

@@ -56,3 +56,8 @@ def test_two_process_runtime():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out[-2000:]}"
         assert "OK process=" in out, f"process {pid} no OK line:\n{out[-2000:]}"
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow

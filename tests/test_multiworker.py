@@ -85,3 +85,9 @@ def test_two_workers_share_port():
                 gateway.kill()
         backend.kill()
         backend.wait()
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+import pytest  # noqa: E402  (slow-mark only)
+pytestmark = pytest.mark.slow

@@ -263,3 +263,8 @@ class TestPPInt8KV:
             assert 0 < len(ids) <= 6
         finally:
             await batcher.stop()
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow

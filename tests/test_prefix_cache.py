@@ -262,3 +262,8 @@ class TestPrefixCache:
             assert all(k is None for k in batcher._pfx_keys)
         finally:
             await batcher.stop()
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow

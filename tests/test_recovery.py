@@ -80,3 +80,9 @@ class TestBackendRestartRecovery:
             await backend.server.stop(grace=None)  # idempotent
             if restarted is not None:
                 await restarted.__aexit__()
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+import pytest  # noqa: E402  (slow-mark only)
+pytestmark = pytest.mark.slow

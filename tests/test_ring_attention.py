@@ -178,3 +178,8 @@ class TestUlysses:
         q, k, v = _qkv(h=2)  # 2 heads over sequence=4
         with pytest.raises(ValueError):
             ulysses_attention(q, k, v, seq_mesh)
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow

@@ -200,3 +200,8 @@ class TestMistralModel:
         outs, reasons = engine.generate(prompts, max_new_tokens=6, seed=0)
         assert len(outs) == 2 and all(len(o) <= 6 for o in outs)
         assert all(r in ("length", "stop") for r in reasons)
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow

@@ -242,3 +242,8 @@ class TestValidation:
                 moe.CONFIGS["tiny-moe"],
                 spec_cfg(model="tiny-moe"),
             )
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow

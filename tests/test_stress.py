@@ -168,3 +168,8 @@ async def test_gateway_survives_flaky_backend():
                 )
         finally:
             await gateway.stop()
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow

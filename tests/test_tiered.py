@@ -154,3 +154,8 @@ async def test_sidecar_with_tiers():
     finally:
         await channel.close()
         await side.stop()
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow

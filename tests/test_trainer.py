@@ -81,3 +81,8 @@ class TestCheckpointResume:
         )
         out, reasons = eng.generate([[3, 1, 4]], max_new_tokens=4)
         assert len(out[0]) <= 4 and reasons[0] in ("stop", "length")
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow

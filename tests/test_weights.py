@@ -225,3 +225,8 @@ def test_sharded_index_layout(tmp_path):
 
     cfg, params = load_hf_checkpoint(path)
     assert params["layers"]["wqkv"].shape[0] == cfg.num_layers
+
+
+# Heavy JAX-compile/serving integration module: excluded from the
+# fast `make test` signal; always in `make test-all` / CI.
+pytestmark = pytest.mark.slow
