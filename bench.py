@@ -20,6 +20,11 @@ Environment knobs:
                          the decode bottleneck at small batch)
   GGRMCP_BENCH_KV        KV-cache storage: "" (model dtype, default) or
                          "int8" (halves KV HBM + decode KV bandwidth)
+  GGRMCP_BENCH_SYNTH=1   synthetic int8 weights (random, initialized
+                         directly in quantized form): perf staging for
+                         models whose dense init exceeds the chip HBM
+                         (llama3-8b on v5e-1). Requires _QUANT=int8;
+                         the result line carries synthetic_weights:true
   GGRMCP_BENCH_CPU=1     force the CPU platform (tiny model)
 """
 
@@ -201,10 +206,12 @@ async def _run_bench() -> dict:
     )
     quantize = os.environ.get("GGRMCP_BENCH_QUANT", "")
     kv_dtype = os.environ.get("GGRMCP_BENCH_KV", "")
+    synth = os.environ.get("GGRMCP_BENCH_SYNTH", "") == "1"
     serving = ServingConfig(
         model=model,
         quantize=quantize,
         kv_cache_dtype=kv_dtype,
+        synthetic_weights=synth,
         mesh=MeshConfig(tensor=0),  # all local devices on the tensor axis
         batching=BatchingConfig(
             max_batch_size=min(32, max(8, sessions)),
@@ -338,6 +345,9 @@ async def _run_bench() -> dict:
             "model": model,
             "quantize": quantize or "bf16",
             "kv_cache_dtype": kv_dtype or "model-dtype",
+            # Random weights in quantized form (perf staging — same op
+            # graph and HBM traffic as real weights; text meaningless).
+            **({"synthetic_weights": True} if synth else {}),
             "tokenizer": serving.tokenizer_path or "byte-level",
             "sessions": sessions,
             "total_calls": total,
@@ -628,7 +638,7 @@ def _banked_tpu_line() -> str | None:
     if stamped != _current_round():
         return None
     for name in ("bench_tpu.json", "bench_tpu_int8.json",
-                 "bench_tpu_tiny.json"):
+                 "bench_tpu_8b.json", "bench_tpu_tiny.json"):
         path = os.path.join(_ARTIFACT_DIR, name)
         try:
             with open(path) as f:

@@ -352,6 +352,15 @@ class ServingConfig:
     # context/slot headroom; decode attention takes the XLA path so
     # the cast+scale fuse into the matmuls). Composes with `quantize`.
     kv_cache_dtype: str = ""
+    # Benchmark staging: initialize the int8-quantized weight structure
+    # DIRECTLY with synthetic values (random int8 + small scales)
+    # instead of dense-init-then-quantize. Serving throughput and MFU
+    # are weight-value independent, so this gives honest perf numbers
+    # for models whose dense init would not fit the chip (llama3-8b
+    # bf16 is 16 GB — a v5e-1's entire HBM — while its int8 form is
+    # ~8 GB). Outputs are meaningless; requires quantize="int8" and no
+    # checkpoint. The bench labels runs using it.
+    synthetic_weights: bool = False
     # Ring-buffer KV for sliding-window models: cache capacity becomes
     # window + prefill_chunk - 1 instead of the full context, and
     # generation length is bounded by the model's RoPE range, not KV
@@ -516,6 +525,17 @@ class Config:
                 f"unknown serving.kv_cache_dtype "
                 f"{self.serving.kv_cache_dtype!r}; supported: 'int8'"
             )
+        if self.serving.synthetic_weights:
+            if self.serving.quantize != "int8":
+                raise ValueError(
+                    "serving.synthetic_weights initializes the int8 "
+                    "weight structure; it requires quantize='int8'"
+                )
+            if self.serving.checkpoint_path or self.serving.hf_checkpoint_path:
+                raise ValueError(
+                    "serving.synthetic_weights is random-weight perf "
+                    "staging; it cannot combine with a checkpoint"
+                )
         # kv_cache_dtype='int8' composes with mesh.stage > 1: the
         # staged forward threads QuantizedArray K/V leaves through its
         # tick schedule (parallel/pipeline.py::_pipelined_cached).
