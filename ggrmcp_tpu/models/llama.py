@@ -312,12 +312,19 @@ def attention_block(
                 ),
             )
             k_all, v_all = dequantize(cache_k), dequantize(cache_v)
+            # The current step's K/V as the cache will replay them:
+            # a sequence-parallel prefill (attn_impl) must attend these
+            # round-tripped values, not the raw bf16 ones, so sp and
+            # XLA prefill of the same prompt carry identical
+            # quantization error into identical decode.
+            k_step, v_step = dequantize(qk), dequantize(qv)
             use_flash = False  # materializing bf16 KV for the Pallas
             # kernel would forfeit the int8 bandwidth win
         else:
             cache_k = cache_k.at[batch_idx, write_pos].set(k)
             cache_v = cache_v.at[batch_idx, write_pos].set(v)
             k_all, v_all = cache_k, cache_v
+            k_step, v_step = k, v
         kv_len = cache_len + s
         q_offset = cache_len
         if ring:
@@ -331,6 +338,7 @@ def attention_block(
             )
     else:
         k_all, v_all, kv_len, q_offset = k, v, None, None
+        k_step, v_step = k, v
         k_positions = None
 
     if attn_impl is not None:
@@ -345,11 +353,13 @@ def attention_block(
         if kvh != h:
             reps = h // kvh
             attn_out = attn_impl(
-                q, jnp.repeat(k, reps, axis=2), jnp.repeat(v, reps, axis=2),
+                q,
+                jnp.repeat(k_step, reps, axis=2),
+                jnp.repeat(v_step, reps, axis=2),
                 causal=True,
             )
         else:
-            attn_out = attn_impl(q, k, v, causal=True)
+            attn_out = attn_impl(q, k_step, v_step, causal=True)
     else:
         attn_out = attention(
             q, k_all, v_all, causal=True, q_offset=q_offset, kv_len=kv_len,

@@ -225,19 +225,18 @@ class GenerationEngine:
 
         self._sp_n = mesh_mod.axis_size(self.mesh, "sequence")
         mode = self.serving.sp_prefill
-        # Features sp-prefill cannot compose with (one disable policy):
-        # int8 KV — the sp path attends raw bf16 K/V while the cache
-        # stores int8, so the same prompt would decode differently
-        # through sp vs XLA prefill; sliding window — ring/Ulysses have
-        # no window mask (models/llama.py asserts this too).
-        for incompatible, why in (
-            (self.kv_dtype, "kv_cache_dtype=int8"),
-            (self.cfg.sliding_window, f"sliding-window model {self.cfg.name}"),
-        ):
-            if mode and incompatible:
-                if self._sp_n > 1:
-                    logger.warning("sp_prefill disabled with %s", why)
-                mode = ""
+        # int8 KV composes: the sp path attends the int8 round-tripped
+        # step K/V (models/llama.py::attention_block k_step), so sp and
+        # XLA prefill of one prompt carry identical quantization error.
+        # Sliding window remains excluded — ring/Ulysses have no window
+        # mask (models/llama.py asserts this too).
+        if mode and self.cfg.sliding_window:
+            if self._sp_n > 1:
+                logger.warning(
+                    "sp_prefill disabled with sliding-window model %s",
+                    self.cfg.name,
+                )
+            mode = ""
         self.sp_prefill = mode if (self._sp_n > 1 and mode) else ""
         self.sp_min_seq = self.serving.sp_prefill_min_seq
         if not self.sp_prefill:

@@ -124,6 +124,39 @@ class TestSequenceParallelServing:
         ref_out, _ = ref_engine.generate([prompt], max_new_tokens=8, seed=0)
         assert sp_out == ref_out
 
+    def test_sp_prefill_composes_with_int8_kv(self, seq_mesh):
+        """int8 KV under SP prefill: the sp path attends the int8
+        round-tripped step K/V (llama.attention_block k_step), so
+        greedy decode equals the non-SP int8 engine exactly — the
+        compat-matrix hole the r2 exclusion carved out, closed."""
+        from ggrmcp_tpu.core.config import ServingConfig
+        from ggrmcp_tpu.models import llama
+        from ggrmcp_tpu.serving.engine import GenerationEngine
+
+        cfg = llama.CONFIGS["tiny-llama"]
+        prompt = list(range(3, 40))
+        sp_engine = GenerationEngine(
+            cfg,
+            ServingConfig(
+                model="tiny-llama",
+                mesh=MeshConfig(sequence=4, data=0, tensor=1),
+                sp_prefill="ring", sp_prefill_min_seq=64,
+                kv_cache_dtype="int8",
+            ),
+            mesh=seq_mesh,
+        )
+        assert sp_engine.sp_prefill == "ring"  # no longer disabled
+        ref_engine = GenerationEngine(
+            cfg,
+            ServingConfig(
+                model="tiny-llama", sp_prefill="", kv_cache_dtype="int8"
+            ),
+            mesh=mesh_mod.build_mesh(MeshConfig(sequence=1, tensor=0)),
+        )
+        sp_out, _ = sp_engine.generate([prompt], max_new_tokens=8, seed=0)
+        ref_out, _ = ref_engine.generate([prompt], max_new_tokens=8, seed=0)
+        assert sp_out == ref_out
+
     async def test_batcher_sp_admission(self, seq_mesh):
         """Continuous-batcher admission prefill routes long prompts
         through the SP path (engine.prefill_forward gate)."""
