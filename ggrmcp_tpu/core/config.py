@@ -335,6 +335,17 @@ class ServingConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     batching: BatchingConfig = field(default_factory=BatchingConfig)
     port: int = 50051
+    # Unix-domain-socket listen path. When set, the sidecar binds
+    # `unix:{uds_path}` instead of TCP. The co-located deployment
+    # (gateway --tpu) defaults to a private UDS because the hop is
+    # loopback-only by construction and a UDS round trip costs
+    # measurably less shared-core CPU than TCP loopback
+    # (docs/BENCH.md proxy-phase table).
+    uds_path: str = ""
+    # `--tpu` co-launch transport: auto-generate a per-process UDS for
+    # the gateway→sidecar hop (uds_path, when set, pins the path).
+    # False restores a TCP loopback hop on serving.port.
+    colaunch_uds: bool = True
     # Orbax checkpoint directory with model params (empty → random init).
     checkpoint_path: str = ""
     # HuggingFace Llama checkpoint directory (config.json +
@@ -512,6 +523,13 @@ class Config:
             raise ValueError(
                 f"unknown serving.sp_prefill {self.serving.sp_prefill!r}; "
                 f"supported: 'ring', 'ulysses'"
+            )
+        if len(self.serving.uds_path.encode()) > 100:
+            # AF_UNIX sun_path caps at ~108 bytes; fail at parse time,
+            # not as an opaque bind error after model load.
+            raise ValueError(
+                f"serving.uds_path too long for AF_UNIX "
+                f"({len(self.serving.uds_path.encode())} > 100 bytes)"
             )
         if self.serving.quantize not in QUANTIZE_MODES:
             # Catch typos at parse time, before minutes of checkpoint

@@ -385,6 +385,9 @@ class FastLaneServer:
                 b"Access-Control-Allow-Origin: *\r\n" + cors_tail
             )
         self._const = b"".join(h + b"\r\n" for h in const)
+        # Most calls (curl, SDKs, the bench) carry no Origin: the whole
+        # header block is one precomputed bytes object.
+        self._const_no_origin = self._const + self._cors_const
         self._json_200 = (
             b"HTTP/1.1 200 OK\r\n"
             b"Content-Type: application/json; charset=utf-8\r\n"
@@ -675,7 +678,7 @@ class FastLaneServer:
             return self._const
         origin = req_headers.get("origin")
         if origin is None:
-            return self._const + self._cors_const
+            return self._const_no_origin
         # fused parity: wildcard allowlists (and exact matches) echo the
         # caller's Origin; otherwise fall back to the first allowed one
         if self._cors_wildcard or origin in self.cors.allowed_origins:

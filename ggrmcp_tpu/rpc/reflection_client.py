@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import math
 from typing import Any, AsyncIterator, Optional
 
 import grpc
@@ -199,10 +200,151 @@ class ReflectionClient:
 # ---------------------------------------------------------------------------
 
 
+_FD = descriptor_pb2.FieldDescriptorProto
+
+# Scalar field types the compiled fast transcoder handles with plain
+# Python values. Deliberately excluded: 64-bit ints (protojson maps
+# them to strings), bytes (base64), enums (name mapping), and FLOAT on
+# both sides (parse: ParseDict range-checks float32 and raises on
+# overflow where setattr stores inf; dump: json_format applies float32
+# precision rounding). DOUBLE dumps carry a finiteness check — protojson
+# serializes nonfinite doubles as the strings "Infinity"/"NaN".
+_FAST_PARSE_TYPES = {
+    _FD.TYPE_STRING: (str,),
+    _FD.TYPE_BOOL: (bool,),
+    _FD.TYPE_INT32: (int,),
+    _FD.TYPE_SINT32: (int,),
+    _FD.TYPE_SFIXED32: (int,),
+    _FD.TYPE_UINT32: (int,),
+    _FD.TYPE_FIXED32: (int,),
+    _FD.TYPE_DOUBLE: (int, float),
+}
+_FAST_DUMP_TYPES = frozenset({
+    _FD.TYPE_STRING, _FD.TYPE_BOOL, _FD.TYPE_INT32, _FD.TYPE_SINT32,
+    _FD.TYPE_SFIXED32, _FD.TYPE_UINT32, _FD.TYPE_FIXED32, _FD.TYPE_DOUBLE,
+})
+
+
+_SLOW = None  # sentinel entry: this key exists but needs json_format
+
+
+def _compile_parse_table(desc):
+    """JSON-key → (field name, accepted Python types, repeated?) parse
+    table. Fields json_format must handle (nested messages, maps,
+    64-bit ints, bytes, enums) become _SLOW entries — the fast path
+    bails to ParseDict only when a request actually uses one, so e.g. a
+    GenerateRequest without `sampling` stays fast. Messages with oneofs
+    refuse outright (None): protojson rejects two members of one oneof
+    in a single object, which setattr last-wins would silently accept.
+    protojson accepts both the original field name and the camelCase
+    json_name — the table carries both spellings."""
+    # Multi-member oneofs refuse outright: protojson rejects two
+    # members of one oneof in a single object, which setattr last-wins
+    # would silently accept. Single-member oneofs (incl. the synthetic
+    # ones proto3 `optional` creates) have no such rule.
+    if any(len(o.fields) > 1 for o in desc.oneofs):
+        return None
+    table = {}
+    for f in desc.fields:
+        types = _FAST_PARSE_TYPES.get(f.type)
+        if f.message_type is not None or types is None:
+            entry = _SLOW
+        else:
+            entry = (
+                f.name, types, f.label == f.LABEL_REPEATED,
+                # Nonfinite doubles (json.loads turns 1e400 into inf)
+                # must divert: ParseDict rejects them with a ParseError
+                # where setattr would silently store inf.
+                f.type == _FD.TYPE_DOUBLE,
+            )
+        table[f.name] = entry
+        table[f.json_name] = entry
+    return table
+
+
+def _compile_dump_table(desc):
+    """field name → (json_name, repeated?) for the scalar (or
+    repeated-scalar) fields of a message; fields json_format must
+    handle are simply absent — _fast_dump falls back when a set field
+    is not in the table, so a response only pays MessageToDict when it
+    actually populates a complex field. (Oneofs need no special
+    handling here: ListFields reports whichever member is set, exactly
+    like MessageToDict.)"""
+    table = {}
+    for f in desc.fields:
+        if f.message_type is None and f.type in _FAST_DUMP_TYPES:
+            table[f.name] = (
+                f.json_name,
+                f.label == f.LABEL_REPEATED,
+                # protojson serializes nonfinite doubles as the strings
+                # "Infinity"/"-Infinity"/"NaN"; a bare Python inf would
+                # json.dumps to invalid JSON — divert those responses.
+                f.type == _FD.TYPE_DOUBLE,
+            )
+    return table
+
+
+def _fast_parse(request, arguments: dict, table) -> bool:
+    """Set fields directly (upb C setattr/extend). Returns False — with
+    the request possibly part-populated; caller must use a FRESH
+    message — when anything needs the slow path: unknown key (so
+    ParseDict raises the exact reference-parity error), bool-for-int
+    (type() is exact), wrong type, non-list for a repeated field.
+    Out-of-range ints raise ValueError like ParseDict."""
+    for key, value in arguments.items():
+        entry = table.get(key, _SLOW)
+        if entry is _SLOW:
+            return False
+        name, types, repeated, needs_finite = entry
+        if repeated:
+            if type(value) is not list or any(
+                type(v) not in types for v in value
+            ):
+                return False
+            if needs_finite and not all(
+                math.isfinite(v) for v in value
+            ):
+                return False
+            getattr(request, name).extend(value)
+        else:
+            if type(value) not in types:
+                return False
+            if needs_finite and not math.isfinite(value):
+                return False
+            setattr(request, name, value)
+    return True
+
+
+def _fast_dump(message, table):
+    """json_format.MessageToDict equivalent for scalar messages:
+    ListFields yields only explicitly-set fields (and non-empty
+    repeateds), matching protojson's omission of default values.
+    Returns None — caller uses MessageToDict — when the message set a
+    field the table can't represent."""
+    out = {}
+    for f, v in message.ListFields():
+        entry = table.get(f.name)
+        if entry is None:
+            return None
+        json_name, repeated, check_finite = entry
+        if repeated:
+            v = list(v)
+            if check_finite and not all(math.isfinite(x) for x in v):
+                return None
+        elif check_finite and not math.isfinite(v):
+            return None
+        out[json_name] = v
+    return out
+
+
 class DynamicInvoker:
     """Generic unary + server-streaming invocation using dynamic messages
     (reflection.go:333-391 parity, plus streaming which the reference
-    rejected)."""
+    rejected). Flat all-scalar messages ride a descriptor-compiled
+    transcoder (~2x less per-call Python than json_format — the Go
+    reference gets compiled protojson for free); anything nested,
+    repeated, mapped, 64-bit, bytes, or enum falls back to json_format
+    for exact protojson semantics."""
 
     def __init__(self, channel: grpc.aio.Channel):
         self._channel = channel
@@ -237,7 +379,12 @@ class DynamicInvoker:
                 request_serializer=req_cls.SerializeToString,
                 response_deserializer=resp_cls.FromString,
             )
-            entry = (req_cls, callable_)
+            entry = (
+                req_cls,
+                callable_,
+                _compile_parse_table(method.input_descriptor),
+                _compile_dump_table(method.output_descriptor),
+            )
             self._unary_cache[key] = entry
         return entry
 
@@ -263,14 +410,22 @@ class DynamicInvoker:
         timeout_s: Optional[float] = None,
     ) -> dict[str, Any]:
         """Unary call: JSON dict in → JSON dict out."""
-        req_cls, call = self._unary_entry(method)
+        req_cls, call, parse_table, dump_table = self._unary_entry(method)
         request = req_cls()
-        # protojson-equivalent parse; unknown fields are an error, like
-        # the reference's protojson.Unmarshal (reflection.go:351-359).
-        json_format.ParseDict(arguments, request)
+        if parse_table is None or not _fast_parse(request, arguments, parse_table):
+            # protojson-equivalent parse; unknown fields are an error,
+            # like the reference's protojson.Unmarshal
+            # (reflection.go:351-359). Fresh message: a failed fast
+            # parse may have part-populated the first one.
+            request = req_cls()
+            json_format.ParseDict(arguments, request)
         response = await call(
             request, metadata=headers or None, timeout=timeout_s
         )
+        if dump_table is not None:
+            out = _fast_dump(response, dump_table)
+            if out is not None:
+                return out
         return json_format.MessageToDict(
             response, preserving_proto_field_name=False
         )

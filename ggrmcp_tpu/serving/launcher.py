@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import tempfile
 
 from ggrmcp_tpu.core.config import Config
 from ggrmcp_tpu.gateway.app import Gateway, setup_logging
@@ -20,15 +22,33 @@ logger = logging.getLogger("ggrmcp.serving.launcher")
 async def _run(cfg: Config, extra_targets: list[str]) -> None:
     from ggrmcp_tpu.serving.sidecar import Sidecar
 
+    default_port = type(cfg.serving)().port
+    if (
+        cfg.serving.colaunch_uds
+        and not cfg.serving.uds_path
+        and cfg.serving.port == default_port
+    ):
+        # The co-launched hop never leaves the host, so ride a private
+        # UDS: cheaper per call than TCP loopback on the shared core
+        # (docs/BENCH.md) and no port to collide with. An explicitly
+        # configured serving.port wins over this default — pinning a
+        # port means something external (grpcurl, another gateway)
+        # intends to dial the sidecar over TCP.
+        cfg.serving.uds_path = os.path.join(
+            tempfile.gettempdir(), f"ggrmcp-sidecar-{os.getpid()}.sock"
+        )
     sidecar = Sidecar(cfg.serving)
-    port = await sidecar.start(cfg.serving.port)
+    await sidecar.start(cfg.serving.port)
     # Callers pass only explicitly configured external backends
     # (__main__.py decides placeholder-vs-explicit from flags + config).
-    targets = [f"localhost:{port}"]
+    targets = [sidecar.target]
     for target in extra_targets:
         if target not in targets:
             targets.append(target)
-    logger.info("co-launched sidecar on :%d; gateway backends: %s", port, targets)
+    logger.info(
+        "co-launched sidecar on %s; gateway backends: %s",
+        sidecar.target, targets,
+    )
 
     gateway = Gateway(cfg, targets=targets)
     try:

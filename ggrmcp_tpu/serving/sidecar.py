@@ -100,6 +100,7 @@ class Sidecar:
         self.server: Optional[grpc.aio.Server] = None
         self.health = HealthService()
         self.port = 0
+        self.target = ""  # dialable target string, set by start()
         self._profile_lock = asyncio.Lock()
 
     # ------------------------------------------------------------------
@@ -421,8 +422,20 @@ class Sidecar:
         )
         ReflectionService(services).attach(self.server)
         self.health.attach(self.server)
-        bind = port if port is not None else self.serving.port
-        self.port = self.server.add_insecure_port(f"0.0.0.0:{bind}")
+        if self.serving.uds_path:
+            # UDS listen (co-launch default): no TCP socket at all —
+            # the gateway dials `self.target`. gRPC returns 1 for a
+            # successful unix bind, so `port` stays 0 in this mode.
+            if self.server.add_insecure_port(f"unix:{self.serving.uds_path}") == 0:
+                raise OSError(
+                    f"failed to bind unix:{self.serving.uds_path}"
+                )
+            self.port = 0
+            self.target = f"unix:{self.serving.uds_path}"
+        else:
+            bind = port if port is not None else self.serving.port
+            self.port = self.server.add_insecure_port(f"0.0.0.0:{bind}")
+            self.target = f"localhost:{self.port}"
         if self.batcher is not None:
             # Compile decode/admission programs before accepting traffic
             # (device-bound → executor, not the event loop).
@@ -438,8 +451,8 @@ class Sidecar:
             self.spec_batcher.start()
         await self.server.start()
         logger.info(
-            "sidecar serving %s (%s) on :%d",
-            self.serving.model, self.family, self.port,
+            "sidecar serving %s (%s) on %s",
+            self.serving.model, self.family, self.target,
         )
         return self.port
 
@@ -450,6 +463,11 @@ class Sidecar:
             await self.batcher.stop()
         if self.server is not None:
             await self.server.stop(grace=2.0)
+        if self.serving.uds_path:
+            try:
+                os.unlink(self.serving.uds_path)
+            except OSError:
+                pass
 
 
 def _strip_trailing_pads(row: "np.ndarray") -> list[int]:

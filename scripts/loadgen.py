@@ -5,12 +5,15 @@ the gateway's event loop is not competing with the load generator for
 the GIL (the round-1 proxy bench ran client+gateway+backend on one loop,
 understating gateway capacity).
 
-The client is a raw asyncio-streams HTTP/1.1 client, not aiohttp: on a
-single-core host every millisecond the generator burns is a millisecond
-stolen from the gateway under test. One persistent keep-alive connection
-per session, a precomputed request byte-string, and a minimal
-Content-Length response reader keep the per-call client cost ~4x below
-an aiohttp ClientSession call.
+The client is a raw asyncio.Protocol HTTP/1.1 client — not aiohttp, and
+(round 3) not asyncio.streams either: on a single-core host every
+millisecond the generator burns is a millisecond stolen from the
+gateway under test. One persistent keep-alive connection per session, a
+precomputed request byte-string, one future per in-flight call, and a
+Content-Length scan over the response buffer keep the per-call client
+cost an order of magnitude below an aiohttp ClientSession call (streams
+readuntil/readexactly alone cost ~40% of the protocol client's whole
+call).
 
 Protocol with the parent (bench.py):
   1. loadgen connects, performs warmup calls, prints "READY" on stdout.
@@ -46,28 +49,53 @@ def build_request(host: str, body: bytes, session_id: str = "") -> bytes:
     )
 
 
-async def read_response(
-    reader: asyncio.StreamReader,
-) -> tuple[int, dict[str, str], bytes]:
-    """Minimal HTTP/1.1 response reader: status + headers + a
-    Content-Length-delimited body (the gateway always sends one)."""
-    head = await reader.readuntil(b"\r\n\r\n")
-    lines = head[:-4].split(b"\r\n")
-    status = int(lines[0].split(b" ", 2)[1])
-    headers: dict[str, str] = {}
-    for line in lines[1:]:
-        k, _, v = line.partition(b":")
-        headers[k.decode("latin-1").strip().lower()] = v.decode(
-            "latin-1"
-        ).strip()
-    body = b""
-    length = headers.get("content-length")
-    if length:
-        body = await reader.readexactly(int(length))
-    return status, headers, body
+class _ClientProtocol(asyncio.Protocol):
+    """One keep-alive connection; exactly one in-flight request at a
+    time (closed-loop session). data_received frames the response by
+    Content-Length and resolves the waiter with (head, payload)."""
+
+    def __init__(self) -> None:
+        self.transport: asyncio.Transport | None = None
+        self.buf = b""
+        self.waiter: asyncio.Future | None = None
+        self.closed: Exception | None = None
+
+    def connection_made(self, transport: asyncio.BaseTransport) -> None:
+        self.transport = transport  # type: ignore[assignment]
+
+    def connection_lost(self, exc) -> None:
+        # Remember closure even when no call is in flight: a write into
+        # a closed transport is silently dropped, so the next one_call
+        # must fail fast instead of waiting forever on its response.
+        self.closed = exc or ConnectionResetError("server closed connection")
+        if self.waiter is not None and not self.waiter.done():
+            self.waiter.set_exception(self.closed)
+
+    def data_received(self, data: bytes) -> None:
+        self.buf += data
+        if self.waiter is None:
+            return
+        end = self.buf.find(b"\r\n\r\n")
+        if end < 0:
+            return
+        head = self.buf[:end]
+        lower = head.lower()
+        idx = lower.find(b"content-length:")
+        clen = 0
+        if idx >= 0:
+            eol = lower.find(b"\r\n", idx)
+            clen = int(lower[idx + 15: eol if eol >= 0 else len(lower)])
+        total = end + 4 + clen
+        if len(self.buf) < total:
+            return
+        payload = self.buf[end + 4: total]
+        self.buf = self.buf[total:]
+        waiter, self.waiter = self.waiter, None
+        waiter.set_result((head, payload))
 
 
 async def run(args: argparse.Namespace) -> dict:
+    loop = asyncio.get_running_loop()
     url = urlsplit(args.base_url)
     host, port = url.hostname, url.port
     hostport = f"{host}:{port}"
@@ -80,40 +108,49 @@ async def run(args: argparse.Namespace) -> dict:
     latencies: list[float] = []
 
     async def one_call(
-        reader, writer, record: bool, request: bytes
-    ) -> tuple[int, dict[str, str]]:
+        proto: _ClientProtocol, record: bool, request: bytes
+    ) -> bytes:
         t = time.perf_counter()
-        writer.write(request)
-        await writer.drain()
-        status, headers, payload = await read_response(reader)
-        if status != 200 or b'"error"' in payload:
-            raise RuntimeError(f"call failed ({status}): {payload[:200]!r}")
+        if proto.closed is not None:
+            raise proto.closed
+        waiter = loop.create_future()
+        proto.waiter = waiter
+        proto.transport.write(request)
+        head, payload = await waiter
+        if not head.startswith(b"HTTP/1.1 200") or b'"error"' in payload:
+            raise RuntimeError(
+                f"call failed ({head[:15]!r}): {payload[:200]!r}"
+            )
         if record:
             latencies.append((time.perf_counter() - t) * 1000.0)
-        return status, headers
+        return head
 
-    async def session_worker(calls: int, record: bool) -> tuple:
-        reader, writer = await asyncio.open_connection(host, port)
+    async def session_worker(calls: int, record: bool) -> None:
+        transport, proto = await loop.create_connection(
+            _ClientProtocol, host, port
+        )
         try:
             # First call mints the session; reuse it like a real MCP
             # client (steady-state hot path, not per-call minting).
             request = build_request(hostport, body_bytes)
-            _, headers = await one_call(reader, writer, record, request)
-            sid = headers.get("mcp-session-id", "")
+            head = await one_call(proto, record, request)
+            sid = ""
+            lower = head.lower()
+            idx = lower.find(b"mcp-session-id:")
+            if idx >= 0:
+                eol = lower.find(b"\r\n", idx)
+                sid = head[idx + 15: eol if eol >= 0 else len(head)].strip().decode()
             request = build_request(hostport, body_bytes, sid)
             for _ in range(calls - 1):
-                await one_call(reader, writer, record, request)
+                await one_call(proto, record, request)
         finally:
-            writer.close()
-        return reader, writer
+            transport.close()
 
     for _ in range(args.warmup):
         await session_worker(1, record=False)
 
     print("READY", flush=True)
-    line = await asyncio.get_running_loop().run_in_executor(
-        None, sys.stdin.readline
-    )
+    line = await loop.run_in_executor(None, sys.stdin.readline)
     if line.strip() != "GO":
         raise RuntimeError(f"expected GO, got {line!r}")
 

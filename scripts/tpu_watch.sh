@@ -181,6 +181,16 @@ while true; do
     note "gave up after ${MAX_WAIT_S}s (captured: tiny=$(have_bench bench_tpu_tiny.json && echo y || echo n) 1b=$(have_bench bench_tpu.json && echo y || echo n) attn=$(have_attn && echo y || echo n) int8=$(have_bench bench_tpu_int8.json && echo y || echo n))"
     exit 1
   fi
+  # Never probe while a foreign bench/suite owns the core: a probe's
+  # jax import steals enough single-core CPU to sink a concurrent
+  # measurement (notably the driver's own round-end `python bench.py`).
+  # Our ladder stages don't trip this — they run after the probe,
+  # sequentially in this same loop.
+  if pgrep -f "python bench.py" >/dev/null 2>&1; then
+    note "probe deferred: a bench run owns the core"
+    sleep "$PROBE_EVERY_S"
+    continue
+  fi
   if probe; then
     # Cheapest-first. A stage failure does NOT gate the later stages:
     # re-probe, and only abandon the pass if the tunnel is actually

@@ -80,15 +80,18 @@ SERVICE_NAMES = [
 class InProcessBackend:
     """Owns one in-process server; use as an async context manager."""
 
-    def __init__(self, with_reflection: bool = True, port: int = 0):
+    def __init__(
+        self, with_reflection: bool = True, port: int = 0, uds: str = ""
+    ):
         self.server = grpc.aio.server()
         self.health = HealthService()
         self.port = port  # 0 = ephemeral; fixed port for restart tests
+        self.uds = uds  # unix-socket path; overrides TCP when set
         self.with_reflection = with_reflection
 
     @property
     def target(self) -> str:
-        return f"localhost:{self.port}"
+        return f"unix:{self.uds}" if self.uds else f"localhost:{self.port}"
 
     async def __aenter__(self) -> "InProcessBackend":
         add_service(
@@ -140,10 +143,17 @@ class InProcessBackend:
         if self.with_reflection:
             ReflectionService(SERVICE_NAMES).attach(self.server)
         self.health.attach(self.server)
-        requested = self.port
-        self.port = self.server.add_insecure_port(f"localhost:{requested}")
-        assert self.port != 0, f"bind failed for localhost:{requested}"
-        assert requested in (0, self.port)
+        if self.uds:
+            assert self.server.add_insecure_port(f"unix:{self.uds}") != 0, (
+                f"bind failed for unix:{self.uds}"
+            )
+        else:
+            requested = self.port
+            self.port = self.server.add_insecure_port(
+                f"localhost:{requested}"
+            )
+            assert self.port != 0, f"bind failed for localhost:{requested}"
+            assert requested in (0, self.port)
         await self.server.start()
         return self
 

@@ -1,0 +1,90 @@
+"""`gateway --tpu` co-launch e2e: one process tree serving MCP over
+HTTP with the sidecar registered through discovery — the north star's
+`cmd/grmcp --tpu` shape (BASELINE.json). Round 3 addition: the
+gateway→sidecar hop defaults to a private unix socket
+(serving/launcher.py), so this also pins that the UDS transport carries
+real generate traffic end-to-end.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow  # subprocess JAX compile (~1 min on CPU)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(port: int, body: bytes) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_colaunch_serves_generate_over_uds():
+    gw_port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # stderr goes to a file, not a PIPE: --dev logs enough that an
+    # undrained pipe buffer fills and wedges the child mid-startup.
+    errfile = tempfile.TemporaryFile()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ggrmcp_tpu", "gateway", "--tpu",
+         "--model", "tiny-llama", "--http-port", str(gw_port), "--dev"],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=errfile,
+    )
+    body = json.dumps({
+        "jsonrpc": "2.0", "method": "tools/call", "id": 1,
+        "params": {
+            "name": "ggrmcp_tpu_generateservice_generate",
+            "arguments": {"prompt": "hi", "maxNewTokens": 4},
+        },
+    }).encode()
+    try:
+        deadline = time.monotonic() + 180
+        data = None
+        while time.monotonic() < deadline:
+            try:
+                data = _post(gw_port, body)
+                break
+            except Exception:
+                if proc.poll() is not None:
+                    errfile.seek(0)
+                    err = errfile.read().decode(errors="replace")[-2000:]
+                    raise AssertionError(f"co-launch died during startup:\n{err}")
+                time.sleep(1.0)
+        assert data is not None, "co-launch never became ready"
+        assert "result" in data, data
+        assert data["result"]["content"][0]["text"], data
+
+        # The hop really is a UDS: the launcher's per-process socket
+        # exists and belongs to this gateway's pid.
+        sock = os.path.join(
+            tempfile.gettempdir(), f"ggrmcp-sidecar-{proc.pid}.sock"
+        )
+        assert os.path.exists(sock), f"expected co-launch UDS at {sock}"
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()

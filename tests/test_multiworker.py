@@ -39,11 +39,11 @@ def test_two_workers_share_port():
     gateway = None
     try:
         line = backend.stdout.readline().decode().strip()
-        be_port = int(line.removeprefix("PORT="))
+        be_target = line.removeprefix("TARGET=")
         gw_port = _free_port()
         gateway = subprocess.Popen(
             [sys.executable, "-m", "ggrmcp_tpu", "gateway",
-             "--backend", f"localhost:{be_port}",
+             "--backend", be_target,
              "--http-port", str(gw_port), "--workers", "2", "--dev"],
             cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )

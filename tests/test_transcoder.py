@@ -1,0 +1,176 @@
+"""Compiled fast transcoder vs json_format parity
+(rpc/reflection_client.py::_fast_parse/_fast_dump).
+
+The invoker's hot path sets/reads flat scalar messages directly through
+descriptor-compiled tables; every behavior the fast path claims must
+match protojson semantics exactly, and everything it cannot match must
+refuse to compile (table = None) so json_format handles it.
+"""
+
+import pytest
+from google.protobuf import json_format
+
+from ggrmcp_tpu.rpc.pb import complex_pb2, hello_pb2, serving_pb2
+from ggrmcp_tpu.rpc.reflection_client import (
+    _compile_dump_table,
+    _compile_parse_table,
+    _fast_dump,
+    _fast_parse,
+)
+
+
+class TestCompilation:
+    def test_flat_scalar_message_compiles(self):
+        assert _compile_parse_table(hello_pb2.HelloRequest.DESCRIPTOR)
+        assert _compile_dump_table(hello_pb2.HelloResponse.DESCRIPTOR)
+
+    def test_complex_fields_become_slow_triggers(self):
+        # GenerateRequest: `sampling` (nested message) and `prompt_ids`
+        # (repeated int64) must divert to json_format — but only when
+        # a request actually uses them.
+        table = _compile_parse_table(serving_pb2.GenerateRequest.DESCRIPTOR)
+        assert table["sampling"] is None
+        assert table["promptIds"] is None
+        assert table["prompt"] is not None
+
+    def test_dump_table_omits_complex_fields(self):
+        # Profile: scalar fields present, message/map/enum fields absent
+        # (their presence in a response triggers MessageToDict).
+        table = _compile_dump_table(complex_pb2.Profile.DESCRIPTOR)
+        assert table is not None
+        assert "user_id" in table
+        assert "created_at" not in table
+
+    def test_multi_member_oneof_refuses_parse(self):
+        # protojson rejects two members of a oneof in one JSON object;
+        # the fast path can't detect that, so `contact` disqualifies
+        # Profile from fast parsing entirely.
+        assert _compile_parse_table(complex_pb2.Profile.DESCRIPTOR) is None
+
+    def test_parse_table_carries_both_spellings(self):
+        table = _compile_parse_table(serving_pb2.GenerateRequest.DESCRIPTOR)
+        assert table["max_new_tokens"] is not None
+        assert table["maxNewTokens"] == table["max_new_tokens"]
+
+
+class TestParseParity:
+    def test_sets_fields_like_parsedict(self):
+        fast = hello_pb2.HelloRequest()
+        assert _fast_parse(
+            fast, {"name": "x", "salutation": "Hey"},
+            _compile_parse_table(hello_pb2.HelloRequest.DESCRIPTOR),
+        )
+        slow = hello_pb2.HelloRequest()
+        json_format.ParseDict({"name": "x", "salutation": "Hey"}, slow)
+        assert fast == slow
+
+    def test_unknown_key_falls_back(self):
+        table = _compile_parse_table(hello_pb2.HelloRequest.DESCRIPTOR)
+        assert not _fast_parse(hello_pb2.HelloRequest(), {"nope": 1}, table)
+
+    def test_wrong_type_falls_back(self):
+        table = _compile_parse_table(hello_pb2.HelloRequest.DESCRIPTOR)
+        assert not _fast_parse(hello_pb2.HelloRequest(), {"name": 42}, table)
+
+    def test_bool_for_int_falls_back(self):
+        """protojson rejects JSON true for an int field; type() is
+        exact so the fast path refuses rather than coercing."""
+        table = _compile_parse_table(serving_pb2.GenerateRequest.DESCRIPTOR)
+        assert not _fast_parse(
+            serving_pb2.GenerateRequest(), {"maxNewTokens": True}, table
+        )
+
+    def test_out_of_range_int_raises_valueerror(self):
+        table = _compile_parse_table(serving_pb2.GenerateRequest.DESCRIPTOR)
+        with pytest.raises(ValueError):
+            _fast_parse(
+                serving_pb2.GenerateRequest(),
+                {"maxNewTokens": 2**40}, table,
+            )
+
+    def test_slow_field_use_falls_back(self):
+        table = _compile_parse_table(serving_pb2.GenerateRequest.DESCRIPTOR)
+        assert not _fast_parse(
+            serving_pb2.GenerateRequest(),
+            {"prompt": "x", "sampling": {"temperature": 0.5}}, table,
+        )
+
+    def test_repeated_scalar_parses(self):
+        table = _compile_parse_table(serving_pb2.GenerateRequest.DESCRIPTOR)
+        fast = serving_pb2.GenerateRequest()
+        assert _fast_parse(
+            fast, {"prompt": "x", "stop": ["a", "b"]}, table
+        )
+        slow = serving_pb2.GenerateRequest()
+        json_format.ParseDict({"prompt": "x", "stop": ["a", "b"]}, slow)
+        assert fast == slow
+
+    def test_repeated_wrong_element_type_falls_back(self):
+        table = _compile_parse_table(serving_pb2.GenerateRequest.DESCRIPTOR)
+        assert not _fast_parse(
+            serving_pb2.GenerateRequest(), {"stop": ["a", 3]}, table
+        )
+
+    def test_nonfinite_double_falls_back(self):
+        """json.loads turns 1e400 into inf; ParseDict rejects inf for a
+        double with a ParseError, so the fast path must divert rather
+        than silently store inf (code-review r3 finding)."""
+        table = _compile_parse_table(serving_pb2.EmbedResponse.DESCRIPTOR)
+        assert not _fast_parse(
+            serving_pb2.EmbedResponse(),
+            {"computeMs": float("inf")}, table,
+        )
+        with pytest.raises(json_format.ParseError):
+            json_format.ParseDict(
+                {"computeMs": float("inf")}, serving_pb2.EmbedResponse()
+            )
+
+    def test_float32_field_is_slow(self):
+        """TYPE_FLOAT is excluded from the fast path on both sides:
+        ParseDict range-checks float32 (1e39 -> ParseError) where
+        setattr would store inf."""
+        d = complex_pb2.TreeNode.DESCRIPTOR
+        f = d.fields_by_name.get("weight")
+        if f is None or f.type != f.TYPE_FLOAT:
+            pytest.skip("no float32 field in fixtures")
+        table = _compile_parse_table(d)
+        assert table is None or table["weight"] is None
+
+
+class TestDumpParity:
+    def test_matches_messagetodict(self):
+        msg = hello_pb2.HelloResponse(message="Hello, x!")
+        table = _compile_dump_table(hello_pb2.HelloResponse.DESCRIPTOR)
+        assert _fast_dump(msg, table) == json_format.MessageToDict(
+            msg, preserving_proto_field_name=False
+        )
+
+    def test_defaults_omitted(self):
+        msg = hello_pb2.HelloResponse()  # message field unset
+        table = _compile_dump_table(hello_pb2.HelloResponse.DESCRIPTOR)
+        assert _fast_dump(msg, table) == {}
+        assert json_format.MessageToDict(msg) == {}
+
+    def test_repeated_scalar_dumps(self):
+        msg = serving_pb2.GenerateResponse(
+            text="hi", token_ids=[1, 2, 3], completion_tokens=3
+        )
+        table = _compile_dump_table(serving_pb2.GenerateResponse.DESCRIPTOR)
+        assert _fast_dump(msg, table) == json_format.MessageToDict(
+            msg, preserving_proto_field_name=False
+        )
+
+    def test_set_complex_field_falls_back(self):
+        msg = complex_pb2.Profile(user_id="u")
+        msg.created_at.FromSeconds(1_700_000_000)
+        table = _compile_dump_table(complex_pb2.Profile.DESCRIPTOR)
+        assert _fast_dump(msg, table) is None
+
+    def test_nonfinite_double_dump_falls_back(self):
+        """protojson serializes nonfinite doubles as the STRINGS
+        'Infinity'/'NaN'; a bare Python inf would json.dumps to invalid
+        JSON, so the fast dump diverts (code-review r3 finding)."""
+        msg = serving_pb2.EmbedResponse(compute_ms=float("inf"))
+        table = _compile_dump_table(serving_pb2.EmbedResponse.DESCRIPTOR)
+        assert _fast_dump(msg, table) is None
+        assert json_format.MessageToDict(msg)["computeMs"] == "Infinity"
