@@ -568,11 +568,8 @@ class Config:
                     "kv_ring does not compose with the prefix pool "
                     "(pooled prefixes assume a contiguous layout)"
                 )
-            if self.serving.mesh.stage > 1:
-                raise ValueError(
-                    "kv_ring is not supported under pipeline-parallel "
-                    "serving"
-                )
+            # mesh.stage > 1 composes (round 3): the staged forward
+            # threads the ring layout into each stage's cache block.
 
 
 def default() -> Config:

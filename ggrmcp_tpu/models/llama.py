@@ -244,9 +244,9 @@ def attention_block(
     span clear of live window keys: C >= window + step_len - 1
     (docs/kv_ring_design.md — the engine validates this).
 
-    `attn_impl`: optional attention callable `(q, k, v, causal) -> out`
-    over the CURRENT chunk's keys only — the sequence-parallel
-    (ring/Ulysses) prefill hook. Valid ONLY for fresh prefill
+    `attn_impl`: optional attention callable
+    `(q, k, v, causal, window=None) -> out` over the CURRENT chunk's
+    keys only — the sequence-parallel (ring/Ulysses) prefill hook. Valid ONLY for fresh prefill
     (cache_len == 0 and the cache sized exactly to this chunk): then
     cache attention over the written prefix equals plain causal
     attention over the chunk, and per-row pad keys only influence pad
@@ -343,13 +343,10 @@ def attention_block(
 
     if attn_impl is not None:
         # Sequence-parallel fresh-prefill: attend over this chunk's
-        # keys (contract above). Ring/Ulysses expect equal head counts
-        # and have no sliding-window mask — the model layer enforces
-        # its own contract rather than trusting distant engine guards.
-        assert cfg.sliding_window is None, (
-            "attn_impl (sequence-parallel prefill) does not support "
-            "sliding-window attention"
-        )
+        # keys (contract above). Ring/Ulysses expect equal head counts;
+        # sliding-window models pass the window through (ring masks by
+        # global position, Ulysses gathers full sequences — both match
+        # the local windowed mask exactly, tests/test_ring_attention).
         if kvh != h:
             reps = h // kvh
             attn_out = attn_impl(
@@ -357,9 +354,12 @@ def attention_block(
                 jnp.repeat(k_step, reps, axis=2),
                 jnp.repeat(v_step, reps, axis=2),
                 causal=True,
+                window=cfg.sliding_window,
             )
         else:
-            attn_out = attn_impl(q, k_step, v_step, causal=True)
+            attn_out = attn_impl(
+                q, k_step, v_step, causal=True, window=cfg.sliding_window
+            )
     else:
         attn_out = attention(
             q, k_all, v_all, causal=True, q_offset=q_offset, kv_len=kv_len,

@@ -41,6 +41,7 @@ def _ring_local(
     axis_name: str,
     n: int,
     causal: bool,
+    window: Optional[int] = None,
 ):
     b, sl, h, d = q.shape
     my_idx = jax.lax.axis_index(axis_name)
@@ -71,6 +72,12 @@ def _ring_local(
         )
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk]
+            if window is not None:
+                # Sliding window (Mistral): positions are GLOBAL, so
+                # the window mask composes with block rotation exactly
+                # as on one device; fully-out-of-window key blocks
+                # contribute nothing through the online-softmax merge.
+                mask &= (q_pos[:, None] - k_pos[None, :]) < window
             scores = jnp.where(mask[None, None], scores, NEG_INF)
         m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
@@ -97,17 +104,21 @@ def ring_attention(
     mesh: Mesh,
     causal: bool = True,
     axis: str = "sequence",
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
+    assert window is None or causal, "sliding window requires causal"
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n = sizes.get(axis, 1)
     if n <= 1:
-        return attention_xla(q, k, v, causal=causal)
+        return attention_xla(q, k, v, causal=causal, window=window)
     if q.shape[1] % n != 0:
         raise ValueError(
             f"sequence length {q.shape[1]} not divisible by {axis} axis {n}"
         )
     fn = shard_map(
-        functools.partial(_ring_local, axis_name=axis, n=n, causal=causal),
+        functools.partial(
+            _ring_local, axis_name=axis, n=n, causal=causal, window=window
+        ),
         mesh=mesh,
         in_specs=(_SEQ_SPEC, _SEQ_SPEC, _SEQ_SPEC),
         out_specs=_SEQ_SPEC,
@@ -126,6 +137,7 @@ def _ulysses_local(
     v: jnp.ndarray,
     axis_name: str,
     causal: bool,
+    window: Optional[int] = None,
 ):
     # [B, Sl, H, D] → [B, S, H/n, D]: gather sequence, scatter heads.
     def seq_to_heads(x):
@@ -139,7 +151,9 @@ def _ulysses_local(
         )
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = attention_xla(qh, kh, vh, causal=causal)
+    # Full sequences are local after the gather, so global positions ==
+    # local positions and the ordinary window mask applies unchanged.
+    out = attention_xla(qh, kh, vh, causal=causal, window=window)
     return heads_to_seq(out)
 
 
@@ -150,17 +164,21 @@ def ulysses_attention(
     mesh: Mesh,
     causal: bool = True,
     axis: str = "sequence",
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
+    assert window is None or causal, "sliding window requires causal"
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n = sizes.get(axis, 1)
     if n <= 1:
-        return attention_xla(q, k, v, causal=causal)
+        return attention_xla(q, k, v, causal=causal, window=window)
     if q.shape[2] % n != 0:
         raise ValueError(f"head count {q.shape[2]} not divisible by {axis}={n}")
     if q.shape[1] % n != 0:
         raise ValueError(f"sequence {q.shape[1]} not divisible by {axis}={n}")
     fn = shard_map(
-        functools.partial(_ulysses_local, axis_name=axis, causal=causal),
+        functools.partial(
+            _ulysses_local, axis_name=axis, causal=causal, window=window
+        ),
         mesh=mesh,
         in_specs=(_SEQ_SPEC, _SEQ_SPEC, _SEQ_SPEC),
         out_specs=_SEQ_SPEC,

@@ -169,8 +169,10 @@ class GenerationEngine:
             self.ring_capacity = cap
         self._init_sp_prefill()
         self._init_pp_serving()
-        if self.pp_serving and self.ring_capacity:
-            raise ValueError("kv_ring is not supported under pp serving")
+        # kv_ring composes with pp serving (round 3): the staged
+        # forward threads `ring` into each stage's layer block, so
+        # mod-C writes + absolute-position masking apply per stage
+        # (parallel/pipeline.py::_run_block_cached).
         # int8 KV composes with PP serving: the staged forward's cache
         # bookkeeping goes through quant.kv_map, so QuantizedArray K/V
         # leaves thread the tick schedule like dense ones
@@ -228,15 +230,18 @@ class GenerationEngine:
         # int8 KV composes: the sp path attends the int8 round-tripped
         # step K/V (models/llama.py::attention_block k_step), so sp and
         # XLA prefill of one prompt carry identical quantization error.
-        # Sliding window remains excluded — ring/Ulysses have no window
-        # mask (models/llama.py asserts this too).
-        if mode and self.cfg.sliding_window:
-            if self._sp_n > 1:
-                logger.warning(
-                    "sp_prefill disabled with sliding-window model %s",
-                    self.cfg.name,
-                )
-            mode = ""
+        # Sliding window composes too (round 3): ring masks by global
+        # position, Ulysses gathers full sequences — the model layer
+        # passes cfg.sliding_window through the attn_impl contract.
+        if mode and self.serving.kv_ring and self._sp_n > 1:
+            # Ring-capacity caches violate the sp fresh-prefill
+            # contract (cache sized exactly to the chunk) — a prompt
+            # longer than the ring would wrap mid-prefill.
+            raise ValueError(
+                "sp_prefill does not compose with kv_ring: ring-capacity "
+                "caches break the fresh-prefill cache-sized-to-chunk "
+                "contract (chunked admission serves long prompts instead)"
+            )
         self.sp_prefill = mode if (self._sp_n > 1 and mode) else ""
         self.sp_min_seq = self.serving.sp_prefill_min_seq
         if not self.sp_prefill:
@@ -253,8 +258,8 @@ class GenerationEngine:
         )
         mesh = self.mesh
 
-        def sp_attn(q, k, v, causal=True):
-            return impl(q, k, v, mesh, causal=causal)
+        def sp_attn(q, k, v, causal=True, window=None):
+            return impl(q, k, v, mesh, causal=causal, window=window)
 
         self._sp_attn = sp_attn
 
@@ -317,7 +322,7 @@ class GenerationEngine:
         contiguous request-sized caches keep ring=False."""
         if self.pp_serving:
             return self._pp.pipeline_forward_cached(
-                params, self.cfg, tokens, cache, self.mesh
+                params, self.cfg, tokens, cache, self.mesh, ring=ring
             )
         if self.fam is moe_mod:
             return self.fam.forward(

@@ -202,6 +202,62 @@ class TestMistralModel:
         assert all(r in ("length", "stop") for r in reasons)
 
 
+class TestSPWindowedPrefill:
+    """sp_prefill x sliding-window (round-3 compat close): windowed
+    ring/Ulysses masking makes the sequence-parallel prefill path legal
+    for Mistral-family models; greedy decode must equal the non-SP
+    engine exactly."""
+
+    def test_sp_engine_matches_local(self):
+        from ggrmcp_tpu.parallel import mesh as mesh_mod
+
+        seq_mesh = mesh_mod.build_mesh(
+            MeshConfig(sequence=4, data=0, tensor=1)
+        )
+        sp_engine = GenerationEngine(
+            CFG,
+            ServingConfig(
+                model="tiny-mistral",
+                mesh=MeshConfig(sequence=4, data=0, tensor=1),
+                sp_prefill="ring", sp_prefill_min_seq=64,
+            ),
+            mesh=seq_mesh,
+        )
+        assert sp_engine.sp_prefill == "ring"  # no longer disabled
+        ref_engine = GenerationEngine(
+            CFG,
+            ServingConfig(model="tiny-mistral", sp_prefill=""),
+            mesh=mesh_mod.build_mesh(MeshConfig(sequence=1, tensor=0)),
+        )
+        # 37 tokens bucket to 64 (>= min_seq, divisible by 4); the
+        # prompt exceeds the window of 16 so the mask really bites.
+        prompt = list(range(3, 40))
+        sp_out, _ = sp_engine.generate([prompt], max_new_tokens=8, seed=0)
+        ref_out, _ = ref_engine.generate([prompt], max_new_tokens=8, seed=0)
+        assert sp_out == ref_out
+
+    def test_sp_rejected_with_kv_ring(self):
+        """kv_ring caches are ring-capacity sized; the sp fresh-prefill
+        contract needs the cache sized to the full chunk — the engine
+        must refuse the combination loudly."""
+        from ggrmcp_tpu.parallel import mesh as mesh_mod
+
+        seq_mesh = mesh_mod.build_mesh(
+            MeshConfig(sequence=4, data=0, tensor=1)
+        )
+        with pytest.raises(ValueError, match="kv_ring"):
+            GenerationEngine(
+                CFG,
+                ServingConfig(
+                    model="tiny-mistral",
+                    mesh=MeshConfig(sequence=4, data=0, tensor=1),
+                    sp_prefill="ring", sp_prefill_min_seq=64,
+                    kv_ring=True,
+                ),
+                mesh=seq_mesh,
+            )
+
+
 # Heavy JAX-compile/serving integration module: excluded from the
 # fast `make test` signal; always in `make test-all` / CI.
 pytestmark = pytest.mark.slow
