@@ -290,8 +290,10 @@ def _parse_head(
 ) -> tuple[str, str, str, dict[str, str], list[tuple[str, str]]]:
     """Parse request line + headers. Returns (method, target, version,
     headers-lowercased-last-wins, all-pairs-in-order). `pairs` keeps
-    every value for multi-valued headers — session minting snapshots
-    them all (core/sessions.py multi-value fix)."""
+    every value for multi-valued headers AND the sender's original key
+    casing — session minting snapshots them all (core/sessions.py
+    multi-value fix), and the snapshot must fingerprint identically to
+    the aiohttp backend's, which preserves case."""
     lines = head.split(b"\r\n")
     try:
         method_b, target_b, version_b = lines[0].split(b" ", 2)
@@ -305,7 +307,8 @@ def _parse_head(
         key_b, sep, val_b = line.partition(b":")
         if not sep:
             raise ValueError("bad header line")
-        key = key_b.decode("latin-1").strip().lower()
+        key_orig = key_b.decode("latin-1").strip()
+        key = key_orig.lower()
         val = val_b.decode("latin-1").strip()
         if key in headers:
             # repeated headers combine per RFC 9110 for our dict view;
@@ -313,7 +316,7 @@ def _parse_head(
             headers[key] = headers[key] + ", " + val
         else:
             headers[key] = val
-        pairs.append((key, val))
+        pairs.append((key_orig, val))
     return (
         method_b.decode("latin-1"),
         target_b.decode("latin-1"),
@@ -659,16 +662,22 @@ class FastLaneServer:
             sess = self.sessions.get_live(sid)
             if sess is not None:
                 return sess
+        # Merge case-insensitively but keep the first-seen original
+        # casing, matching the aiohttp backend's CIMultiDict snapshot
+        # (gateway/handler.py::_session_for) so both http_impl backends
+        # store identical session headers.
         raw: dict[str, Any] = {}
+        canon: dict[str, str] = {}
         for key, val in pairs:
-            if key in raw:
-                prev = raw[key]
+            first = canon.setdefault(key.lower(), key)
+            if first in raw:
+                prev = raw[first]
                 if isinstance(prev, list):
                     prev.append(val)
                 else:
-                    raw[key] = [prev, val]
+                    raw[first] = [prev, val]
             else:
-                raw[key] = val
+                raw[first] = val
         return self.sessions.get_or_create(sid, raw)
 
     def _finish_headers(self, req_headers: dict[str, str]) -> bytes:

@@ -228,17 +228,24 @@ def cache_specs_pp() -> llama_mod.KVCache:
     )
 
 
-def _run_block_cached(layers_local, x, cfg, positions, ck, cv, clen, fam):
+def _run_block_cached(
+    layers_local, x, cfg, positions, ck, cv, clen, fam, ring=False
+):
     """Scan this stage's local layer block threading its cache block.
     ck/cv: [L/S, mb, S_max, KVH, D] for the current microbatch's rows —
     dense arrays or QuantizedArray (int8 KV) pytrees; scan slices the
     leading layer axis of every leaf either way, and the family layer
-    handles quantized cache blocks natively (llama.attention_block)."""
+    handles quantized cache blocks natively (llama.attention_block).
+    `ring=True`: each stage's cache block has ring layout — the family
+    layer writes at pos % capacity and masks by absolute slot position
+    (models/llama.py::attention_block), identically per stage because
+    positions/lengths are global, not stage-local."""
 
     def body(h, scanned):
         lp, k_layer, v_layer = scanned
         h, (k2, v2) = fam._layer(
-            h, lp, cfg, positions, k_layer, v_layer, clen, use_flash=False
+            h, lp, cfg, positions, k_layer, v_layer, clen, use_flash=False,
+            ring=ring,
         )
         return h, (k2, v2)
 
@@ -253,11 +260,18 @@ def pipeline_forward_cached(
     cache: llama_mod.KVCache,  # k/v [L, B, S_max, KVH, D], layer-staged
     mesh: Mesh,
     num_microbatches: Optional[int] = None,
+    ring: bool = False,
 ) -> tuple[jnp.ndarray, llama_mod.KVCache]:
     """`llama.forward(..., cache=...)` semantics with the layer stack
     (and its KV cache) pipelined over `stage`. Serves both prefill
     (S > 1) and decode (S == 1); microbatches split the BATCH dim, so
     batched decode overlaps stages GPipe-style. Dense Llama only.
+
+    `ring=True`: the cache's sequence dim is a ring (sliding-window
+    serving) — forwarded into every stage's layer block, where writes
+    land at pos % capacity and attention masks by absolute position
+    (llama.attention_block's contract; capacity invariants validated by
+    the engine, docs/kv_ring_design.md).
 
     Must run under jit (every engine path is): this JAX version rejects
     partial-manual shard_map out_specs naming the manual axis when
@@ -272,7 +286,7 @@ def pipeline_forward_cached(
     fam = _family(cfg)
 
     if S_stages == 1:
-        logits, new_cache = fam.forward(params, cfg, tokens, cache)
+        logits, new_cache = fam.forward(params, cfg, tokens, cache, ring=ring)
         return logits, new_cache
 
     M = num_microbatches or (S_stages if b % S_stages == 0 else 1)
@@ -290,7 +304,7 @@ def pipeline_forward_cached(
     layer_specs = jax.tree_util.tree_map(lambda _: P("stage"), params["layers"])
     fwd = partial(
         _pipelined_cached, cfg=cfg, fam=fam, num_stages=S_stages,
-        num_micro=M, mb=mb,
+        num_micro=M, mb=mb, ring=ring,
     )
     out, new_k, new_v = jax.shard_map(
         fwd,
@@ -315,7 +329,7 @@ def pipeline_forward_cached(
 
 def _pipelined_cached(
     layers_local, x_mb, pos_mb, clen_mb, ck, cv, *, cfg, fam, num_stages,
-    num_micro, mb,
+    num_micro, mb, ring=False,
 ):
     """Per-stage body with the stage's local cache block threaded
     through the tick schedule. ck/cv: [L/S, B, S_max, KVH, D]; the tick
@@ -348,7 +362,7 @@ def _pipelined_cached(
             lambda c: jax.lax.dynamic_slice_in_dim(c, row0, mb, axis=1), cv
         )
         y, ck2_m, cv2_m = _run_block_cached(
-            layers_local, state, cfg, pos, ck_m, cv_m, clen, fam
+            layers_local, state, cfg, pos, ck_m, cv_m, clen, fam, ring=ring
         )
         live = (t - stage >= 0) & (t - stage < M)
 

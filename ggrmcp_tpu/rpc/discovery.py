@@ -429,8 +429,15 @@ class ServiceDiscoverer:
             or self._serving_stats_task.done()
         ):
             async def refresh() -> None:
-                stats = await self.get_backend_serving_stats()
-                self._serving_stats_cache = stats
+                try:
+                    stats = await self.get_backend_serving_stats()
+                    self._serving_stats_cache = stats
+                except Exception as exc:  # noqa: BLE001
+                    # Keep the stale snapshot but still stamp the time:
+                    # a failing backend must back off for max_age_s, not
+                    # respawn a doomed task (and leak its exception as
+                    # "never retrieved") on every scrape.
+                    logger.warning("serving-stats refresh failed: %s", exc)
                 self._serving_stats_at = time.monotonic()
 
             self._serving_stats_task = asyncio.create_task(refresh())

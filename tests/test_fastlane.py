@@ -212,7 +212,9 @@ class TestWire:
             assert status == 200
             sess = gw.sessions.get_live(headers["mcp-session-id"])
             assert sess is not None
-            assert sess.headers.get("x-tag") == ["one", "two"]
+            # Original casing preserved (parity with the aiohttp
+            # backend's CIMultiDict snapshot), values merged in order.
+            assert sess.headers.get("X-Tag") == ["one", "two"]
             writer.close()
             await writer.wait_closed()
 

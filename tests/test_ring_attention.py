@@ -98,8 +98,9 @@ class TestSequenceParallelServing:
         sp_logits, sp_cache = jax.jit(
             partial(
                 llama.forward, cfg=cfg,
-                attn_impl=lambda q, k, v, causal=True: ring_attention(
-                    q, k, v, seq_mesh, causal=causal
+                attn_impl=lambda q, k, v, causal=True, window=None:
+                ring_attention(
+                    q, k, v, seq_mesh, causal=causal, window=window
                 ),
             )
         )(params, tokens=tokens, cache=cache_b)
