@@ -25,14 +25,25 @@ import time
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 EVIDENCE = ROOT / "docs" / "ci_evidence"
 
-# (name, command, extra env) — mirrors ci.yml's job steps.
+# (name, command, extra env) — mirrors ci.yml's job steps, plus the
+# security workflow's scan jobs (security.yml:28-105 analogue) and the
+# coverage upload (ci.yml:38-47 analogue, scripts/pycov.py).
 STEPS: list[tuple[str, list[str], dict[str, str]]] = [
     (
-        "test-suite (full, 8-dev virtual mesh)",
-        [sys.executable, "-m", "pytest", "tests/", "-q", "--durations=40"],
+        "test-suite (full, 8-dev virtual mesh, with coverage)",
+        [
+            sys.executable, "scripts/pycov.py", "--include", "ggrmcp_tpu",
+            "--json", "docs/ci_evidence/coverage.json", "--",
+            "-m", "pytest", "tests/", "-q", "--durations=40",
+        ],
         {},
     ),
     ("lint", ["make", "lint"], {}),
+    (
+        "security-scan (gosec/bandit + nancy/pip-audit analogue)",
+        [sys.executable, "scripts/security_scan.py"],
+        {},
+    ),
     (
         "multichip-smoke (graft entry + dryrun)",
         ["make", "smoke"],

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Statement coverage via sys.monitoring (PEP 669) — the coverage.py
+analogue for this hermetic image (coverage/pytest-cov are not
+installed, and installs are off-limits).
+
+The reference CI uploads coverage on every test run
+(/root/reference/.github/workflows/ci.yml:38-47); this provides the
+same measurement natively:
+
+* a LINE-event callback records each (file, line) the interpreter
+  executes, then returns sys.monitoring.DISABLE for that location —
+  after the first hit a line costs nothing, so the tracer's steady-state
+  overhead is near zero even under the JAX-heavy suite (the same
+  mechanism coverage.py 7.4+ uses on 3.12).
+* the denominator is each source file's compiled co_lines() set —
+  actual executable statements, not raw line count.
+
+Usage (what scripts/ci_local.py runs):
+    python scripts/pycov.py --include ggrmcp_tpu -- -m pytest tests/ -q
+
+Monitoring starts BEFORE the target command is imported, so
+module-level statements executed at import time are counted. Only this
+process is traced (the e2e suite's spawned gateways are not — their
+coverage is the e2e transcript's job, not this tool's).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import runpy
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+TOOL = sys.monitoring.COVERAGE_ID
+
+
+def executable_lines(path: pathlib.Path) -> set[int]:
+    """All statement lines in `path`, from the compiled code objects."""
+    try:
+        code = compile(path.read_text(), str(path), "exec")
+    except (SyntaxError, UnicodeDecodeError):
+        return set()
+    lines: set[int] = set()
+    stack = [code]
+    while stack:
+        co = stack.pop()
+        for _, _, line in co.co_lines():
+            if line is not None and line > 0:
+                lines.add(line)
+        for const in co.co_consts:
+            if isinstance(const, type(code)):
+                stack.append(const)
+    return lines
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--include", action="append", required=True,
+        help="package dir (relative to repo root) to measure",
+    )
+    parser.add_argument(
+        "--json", default="", help="optional path for a JSON artifact",
+    )
+    parser.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if len(cmd) < 2 or cmd[0] != "-m":
+        parser.error("command must be: -- -m <module> [args...]")
+    module, mod_args = cmd[1], cmd[2:]
+
+    include_roots = [str((ROOT / inc).resolve()) + "/" for inc in args.include]
+    hits: dict[str, set[int]] = {}
+
+    def on_line(code, line):  # noqa: ANN001 -- sys.monitoring contract
+        fn = code.co_filename
+        for root in include_roots:
+            if fn.startswith(root):
+                hits.setdefault(fn, set()).add(line)
+                break
+        return sys.monitoring.DISABLE
+
+    sys.monitoring.use_tool_id(TOOL, "pycov")
+    sys.monitoring.register_callback(
+        TOOL, sys.monitoring.events.LINE, on_line
+    )
+    sys.monitoring.set_events(TOOL, sys.monitoring.events.LINE)
+
+    sys.argv = [module, *mod_args]
+    rc = 0
+    try:
+        runpy.run_module(module, run_name="__main__", alter_sys=True)
+    except SystemExit as exc:
+        rc = exc.code if isinstance(exc.code, int) else (1 if exc.code else 0)
+    finally:
+        sys.monitoring.set_events(TOOL, 0)
+        sys.monitoring.free_tool_id(TOOL)
+
+    # ---- report ---------------------------------------------------------
+    per_file: list[tuple[str, int, int]] = []  # rel, hit, total
+    for inc in args.include:
+        for path in sorted((ROOT / inc).rglob("*.py")):
+            total = executable_lines(path)
+            if not total:
+                continue
+            got = hits.get(str(path.resolve()), set()) & total
+            per_file.append(
+                (str(path.relative_to(ROOT)), len(got), len(total))
+            )
+
+    tot_hit = sum(h for _, h, _ in per_file)
+    tot_all = sum(t for _, _, t in per_file)
+    pct = 100.0 * tot_hit / tot_all if tot_all else 0.0
+
+    print("\n== coverage (sys.monitoring statement coverage) ==")
+    by_pkg: dict[str, list[int]] = {}
+    for rel, h, t in per_file:
+        pkg = "/".join(rel.split("/")[:2])
+        agg = by_pkg.setdefault(pkg, [0, 0])
+        agg[0] += h
+        agg[1] += t
+    for pkg in sorted(by_pkg):
+        h, t = by_pkg[pkg]
+        print(f"  {pkg:32} {100.0 * h / t:5.1f}%  ({h}/{t})")
+    worst = sorted(per_file, key=lambda x: x[1] / x[2])[:8]
+    print("  least covered files:")
+    for rel, h, t in worst:
+        print(f"    {rel:40} {100.0 * h / t:5.1f}%  ({h}/{t})")
+    print(
+        f"TOTAL statement coverage: {pct:.1f}% ({tot_hit}/{tot_all} lines,"
+        f" {len(per_file)} files)"
+    )
+    if args.json:
+        pathlib.Path(args.json).write_text(json.dumps({
+            "total_pct": round(pct, 2),
+            "lines_hit": tot_hit,
+            "lines_total": tot_all,
+            "files": {
+                rel: {"hit": h, "total": t} for rel, h, t in per_file
+            },
+        }, indent=1))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
