@@ -6,7 +6,7 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: proto descriptors test test-all test-fast bench-cpu smoke e2e lint \
-  ci-local clean
+  ci-local preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
 proto:
@@ -54,6 +54,19 @@ lint:
 # evidence that the CI workflow's steps pass without re-running them.
 ci-local:
 	$(PY) scripts/ci_local.py
+
+# THE round-end gate (round-3 verdict #2: a round must never end red).
+# Runs the full CI-local pipeline against the CURRENT tree and refuses
+# (rc!=0) unless everything passes AND the tree is clean relative to
+# what the transcript evidences. Process: commit all work, run
+# `make preflight`, commit the refreshed docs/ci_evidence/ — only then
+# is the round snapshot allowed.
+preflight:
+	@test -z "$$(git status --porcelain -- ':!docs/ci_evidence' ':!TPU_ATTEMPTS.log' ':!bench_artifacts')" \
+	  || { echo "preflight: tree is dirty — commit first, then gate"; \
+	       git status --short; exit 1; }
+	$(MAKE) ci-local
+	@echo "preflight: PASS — commit docs/ci_evidence/ as the final snapshot"
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

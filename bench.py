@@ -41,6 +41,10 @@ import time
 
 _OWNER_LOCK = threading.Lock()
 _OWNER = {"owner": None}
+# pgid of the detached isolated-proxy child, so the watchdog's
+# os._exit path can reap the whole group instead of orphaning the
+# backend/loadgen it spawned (they'd contaminate the next stage).
+_PROXY_PGID = {"pgid": None}
 # Set (under _OWNER_LOCK) to a complete result line as soon as the
 # headline measurement finishes; if the process wedges in a secondary
 # phase or teardown, the watchdog prints THIS instead of hanging
@@ -190,8 +194,13 @@ async def _run_bench() -> dict:
     from ggrmcp_tpu.gateway.app import Gateway
     from ggrmcp_tpu.serving.sidecar import Sidecar
 
+    # CPU default is tiny-llama-8k: dimensionally IDENTICAL to
+    # tiny-llama (same per-call compute, headline numbers comparable
+    # across rounds) but with an 8k context window, so the long-prompt
+    # phase can push a genuine >=4096-token prompt through the tier
+    # path instead of a 420-token one (round-3 verdict #7).
     model = os.environ.get(
-        "GGRMCP_BENCH_MODEL", "llama-1b" if on_tpu else "tiny-llama"
+        "GGRMCP_BENCH_MODEL", "llama-1b" if on_tpu else "tiny-llama-8k"
     )
     sessions = int(os.environ.get("GGRMCP_BENCH_SESSIONS", "16"))
     total_calls = int(
@@ -208,6 +217,24 @@ async def _run_bench() -> dict:
     quantize = os.environ.get("GGRMCP_BENCH_QUANT", "")
     kv_dtype = os.environ.get("GGRMCP_BENCH_KV", "")
     synth = os.environ.get("GGRMCP_BENCH_SYNTH", "") == "1"
+
+    # Length-tiered KV pools (serving/tiered.py): the headline/prefix
+    # phases ride the short×many tier; the long-prompt phase needs a
+    # long×few tier sized for a >=4096-token prompt + generation +
+    # tick overshoot. Models whose context can't hold 4096+ get the
+    # biggest long tier that fits (the long phase reports the actual
+    # prompt length it achieved).
+    from ggrmcp_tpu.models import get_model as _get_model
+
+    _, _mcfg = _get_model(model)
+    long_prompt_target = min(4096, _mcfg.max_seq_len - max_new - 64)
+    long_tier_seq = min(
+        _mcfg.max_seq_len, long_prompt_target + max_new + 64
+    )
+    kv_tiers = (
+        [[512, min(32, max(8, sessions))], [long_tier_seq, 4]]
+        if long_tier_seq > 512 else []
+    )
     serving = ServingConfig(
         model=model,
         quantize=quantize,
@@ -217,6 +244,7 @@ async def _run_bench() -> dict:
         batching=BatchingConfig(
             max_batch_size=min(32, max(8, sessions)),
             kv_cache_max_seq=512,
+            kv_tiers=kv_tiers,
             decode_steps_per_tick=tick_steps,
             # Exercised by the shared-system-prompt phase below; the
             # main phase's prompts are shorter than min_seq, so its
@@ -393,9 +421,17 @@ async def _run_bench() -> dict:
                 if "error" in data:
                     raise RuntimeError(f"prefix call failed: {data['error']}")
 
+            # Counters are snapshotted around the phase: the headline
+            # phase's prompts are DESIGNED distinct (every one a miss),
+            # so cumulative counters would report the workload mix, not
+            # the cache (round-3 verdict #6 read exactly that artifact).
+            batcher = sidecar.batcher
+            hits0, misses0 = int(batcher.prefix_hits), int(batcher.prefix_misses)
             await prefix_call(0)  # seeds the pool (trickle admission)
             pfx_start = time.perf_counter()
-            n_pfx = 2 * sessions
+            # 4 waves per session: agentic traffic re-sends the shared
+            # preamble on every turn, so model several turns of it.
+            n_pfx = 4 * sessions
             # return_exceptions: let every sibling settle before leaving
             # the phase — teardown must never race in-flight requests.
             results = await asyncio.gather(
@@ -406,14 +442,13 @@ async def _run_bench() -> dict:
             if errs:
                 raise errs[0]
             pfx_elapsed = time.perf_counter() - pfx_start
-            batcher = sidecar.batcher
             prefix = {
                 "prefix_calls_per_sec": round(n_pfx / pfx_elapsed, 2),
                 "prefix_p50_ms": round(
                     statistics.median(pfx_latencies[1:]) * 1000, 1
                 ),
-                "prefix_hits": int(batcher.prefix_hits),
-                "prefix_misses": int(batcher.prefix_misses),
+                "prefix_hits": int(batcher.prefix_hits) - hits0,
+                "prefix_misses": int(batcher.prefix_misses) - misses0,
             }
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: prefix phase failed: {exc!r}", file=sys.stderr)
@@ -421,18 +456,24 @@ async def _run_bench() -> dict:
         # Long-prompt phase: prompts past FLASH_MIN_SEQ so a TPU run
         # exercises the Pallas flash kernel in situ — the headline
         # phase's short prompts never reach it, so without this a
-        # successful TPU bench validates the XLA path only. Prompts are
-        # distinct (burst learning stores nothing; concurrent arrival
-        # keeps them on the fused admission path at the 512 bucket).
+        # successful TPU bench validates the XLA path only. Prompts
+        # are distinct (burst learning stores nothing) and route to
+        # the long×few tier, so the phase measures tier routing +
+        # chunked prefill, not the short pool.
         longp = {}
         try:
-            from ggrmcp_tpu.ops.attention import FLASH_MIN_SEQ
-
-            tgt = FLASH_MIN_SEQ + 164  # tokens ≈ chars (byte tokenizer)
+            # tokens ≈ chars (byte tokenizer): a genuinely long prompt
+            # (>=4096 when the model's context allows) routed to the
+            # long tier — past FLASH_MIN_SEQ so a TPU run exercises the
+            # Pallas flash kernel, and past the short tier so the CPU
+            # run exercises tier routing + chunked prefill in situ.
+            tgt = long_prompt_target
             long_latencies: list[float] = []
+            long_prompt_seen: list[int] = []
 
             async def long_call(i: int) -> None:
-                text = f"case {i}: " + ("the quick brown fox %03d " % i) * 64
+                reps = tgt // 24 + 2
+                text = f"case {i}: " + ("the quick brown fox %03d " % i) * reps
                 body = {
                     "jsonrpc": "2.0", "method": "tools/call",
                     "id": 80000 + i,
@@ -450,9 +491,23 @@ async def _run_bench() -> dict:
                 long_latencies.append(time.perf_counter() - t)
                 if "error" in data:
                     raise RuntimeError(f"long call failed: {data['error']}")
+                # The backend reports how many prompt tokens it really
+                # admitted — the artifact must record THAT, not the
+                # target (tier clamping can truncate silently).
+                try:
+                    payload = json.loads(
+                        data["result"]["content"][0]["text"]
+                    )
+                    long_prompt_seen.append(int(payload["promptTokens"]))
+                except (KeyError, IndexError, TypeError, ValueError):
+                    pass
 
             await long_call(0)  # compile the long bucket off the clock
-            n_long = max(4, sessions // 2)
+            # Bounded: the long tier holds 4 slots, and a 4k-token CPU
+            # prefill is ~10x a short call — 8 calls (two admission
+            # waves) measures tier queueing without unbounding the
+            # phase's wall clock.
+            n_long = min(8, max(4, sessions // 2))
             long_start = time.perf_counter()
             results = await asyncio.gather(
                 *(long_call(1 + i) for i in range(n_long)),
@@ -467,7 +522,10 @@ async def _run_bench() -> dict:
                 "long_p50_ms": round(
                     statistics.median(long_latencies[1:]) * 1000, 1
                 ),
-                "long_prompt_tokens": tgt,
+                "long_prompt_tokens": (
+                    min(long_prompt_seen) if long_prompt_seen else tgt
+                ),
+                "long_prompt_target": tgt,
             }
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: long-prompt phase failed: {exc!r}", file=sys.stderr)
@@ -492,11 +550,64 @@ async def _run_bench() -> dict:
         raise RuntimeError("watchdog claimed output before run completed")
 
     try:
-        proxy = await _proxy_bench()
+        proxy = await _proxy_bench_isolated()
     except Exception as exc:  # secondary metric must not sink the run
         print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
         proxy = {}
     return {**headline, **hbm, **prefix, **longp, **proxy}
+
+
+def _kill_proxy_group() -> None:
+    """SIGKILL the isolated-proxy child's process group (see
+    _proxy_bench_isolated); safe to call when none is live."""
+    import signal
+
+    pgid = _PROXY_PGID["pgid"]
+    if pgid is None:
+        return
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+async def _proxy_bench_isolated() -> dict:
+    """Run the proxy phase in a FRESH interpreter (the PROXY_ONLY CLI
+    path) and parse its result line. By the time the full bench reaches
+    this phase the process carries JAX, the model heap and XLA worker
+    threads — measured on the same quiet core that contamination costs
+    ~20% (1.68k in-process vs 2.15k isolated), and it is exactly the
+    builder-vs-driver gap the round-3 verdict flagged (2.1k proxy-only
+    runs vs 1.94k in the round-end artifact). Process isolation makes
+    the recorded number measure the gateway, not the harness's heap."""
+    env = {**os.environ, "GGRMCP_BENCH_PROXY_ONLY": "1"}
+    # Own session: on timeout the WHOLE process group dies (the child
+    # spawns a hello backend + loadgen of its own; killing just the
+    # child would orphan them onto the shared core — the exact
+    # contamination this phase exists to remove).
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, os.path.abspath(__file__),
+        env=env,
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    _PROXY_PGID["pgid"] = proc.pid
+    try:
+        out, _ = await asyncio.wait_for(proc.communicate(), timeout=600)
+    except (TimeoutError, asyncio.TimeoutError):
+        _kill_proxy_group()
+        await proc.wait()
+        raise RuntimeError("isolated proxy phase timed out")
+    finally:
+        _PROXY_PGID["pgid"] = None
+    lines = out.decode(errors="replace").strip().splitlines()
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"isolated proxy phase failed (rc={proc.returncode})"
+        )
+    parsed = json.loads(lines[-1])
+    return {k: v for k, v in parsed.items() if k.startswith("proxy_")}
 
 
 async def _proxy_bench() -> dict:
@@ -781,7 +892,10 @@ def main() -> None:
                     # The main path finished measuring (stash set) but
                     # wedged in a secondary phase or teardown: emit its
                     # headline line and exit — never hang with no
-                    # result, never discard a finished measurement.
+                    # result, never discard a finished measurement. A
+                    # live isolated-proxy child group dies with us (it
+                    # would otherwise orphan onto the shared core).
+                    _kill_proxy_group()
                     _emit(line)
                     os._exit(0)
                 # Main owns the output but hasn't stashed: it is mid

@@ -111,7 +111,7 @@ have_attn()  {
 
 stage_tiny() {
   note "stage tiny-llama: start"
-  GGRMCP_BENCH_MODEL=tiny-llama GGRMCP_BENCH_SESSIONS=8 GGRMCP_BENCH_CALLS=64 \
+  GGRMCP_BENCH_MODEL=tiny-llama-8k GGRMCP_BENCH_SESSIONS=8 GGRMCP_BENCH_CALLS=64 \
     GGRMCP_BENCH_BUDGET_S=600 timeout 660 python bench.py \
     > "$ART/bench_tpu_tiny.json" 2> "$ART/bench_tpu_tiny.err"
   note "stage tiny-llama: rc=$? on_chip=$(have_bench bench_tpu_tiny.json && echo yes || echo no)"
