@@ -133,7 +133,18 @@ def load_config(args: argparse.Namespace) -> cfgmod.Config:
 
 
 def main(argv: list[str] | None = None) -> int:
-    args = build_parser().parse_args(argv)
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = build_parser()
+    # Reference-CLI compatibility (cmd/grmcp has no subcommands): bare
+    # flags imply `gateway`. This must happen BEFORE parsing — argparse
+    # rejects unknown top-level flags, so a post-parse retry never
+    # runs. Known subcommands come from the parser itself.
+    subcommands = next(
+        a.choices.keys() for a in parser._subparsers._group_actions
+    )
+    if argv and argv[0] not in (*subcommands, "-h", "--help"):
+        argv = ["gateway", *argv]
+    args = parser.parse_args(argv)
     if args.command == "train":
         cfg = load_config(args)
         tc = cfg.training
@@ -164,8 +175,8 @@ def main(argv: list[str] | None = None) -> int:
         run_sidecar(cfg)
         return 0
     if args.command == "gateway" or args.command is None:
-        if args.command is None:
-            args = build_parser().parse_args(["gateway"] + (argv or sys.argv[1:]))
+        if args.command is None:  # bare `python -m ggrmcp_tpu`
+            args = build_parser().parse_args(["gateway"])
         cfg = load_config(args)
         targets = args.backend if args.backend else [cfg.grpc.target]
         if cfg.server.workers > 1:

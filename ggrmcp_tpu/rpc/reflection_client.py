@@ -251,7 +251,7 @@ def _compile_parse_table(desc):
             entry = _SLOW
         else:
             entry = (
-                f.name, types, f.label == f.LABEL_REPEATED,
+                f.name, types, f.is_repeated,
                 # Nonfinite doubles (json.loads turns 1e400 into inf)
                 # must divert: ParseDict rejects them with a ParseError
                 # where setattr would silently store inf.
@@ -275,7 +275,7 @@ def _compile_dump_table(desc):
         if f.message_type is None and f.type in _FAST_DUMP_TYPES:
             table[f.name] = (
                 f.json_name,
-                f.label == f.LABEL_REPEATED,
+                f.is_repeated,
                 # protojson serializes nonfinite doubles as the strings
                 # "Infinity"/"-Infinity"/"NaN"; a bare Python inf would
                 # json.dumps to invalid JSON — divert those responses.

@@ -19,24 +19,30 @@ from ggrmcp_tpu.gateway.app import Gateway, setup_logging
 logger = logging.getLogger("ggrmcp.serving.launcher")
 
 
-async def _run(cfg: Config, extra_targets: list[str]) -> None:
-    from ggrmcp_tpu.serving.sidecar import Sidecar
+def resolve_colaunch_transport(cfg: Config) -> None:
+    """Pick the gateway→sidecar hop for co-launch, in place.
 
+    The co-launched hop never leaves the host, so ride a private UDS:
+    cheaper per call than TCP loopback on the shared core
+    (docs/BENCH.md) and no port to collide with. An explicitly
+    configured serving.port (or uds_path) wins over this default —
+    pinning a port means something external (grpcurl, another gateway)
+    intends to dial the sidecar over TCP."""
     default_port = type(cfg.serving)().port
     if (
         cfg.serving.colaunch_uds
         and not cfg.serving.uds_path
         and cfg.serving.port == default_port
     ):
-        # The co-launched hop never leaves the host, so ride a private
-        # UDS: cheaper per call than TCP loopback on the shared core
-        # (docs/BENCH.md) and no port to collide with. An explicitly
-        # configured serving.port wins over this default — pinning a
-        # port means something external (grpcurl, another gateway)
-        # intends to dial the sidecar over TCP.
         cfg.serving.uds_path = os.path.join(
             tempfile.gettempdir(), f"ggrmcp-sidecar-{os.getpid()}.sock"
         )
+
+
+async def _run(cfg: Config, extra_targets: list[str]) -> None:
+    from ggrmcp_tpu.serving.sidecar import Sidecar
+
+    resolve_colaunch_transport(cfg)
     sidecar = Sidecar(cfg.serving)
     await sidecar.start(cfg.serving.port)
     # Callers pass only explicitly configured external backends

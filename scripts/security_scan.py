@@ -280,6 +280,11 @@ def dependency_audit() -> tuple[list[str], list[str]]:
 
 
 def main() -> int:
+    # --static-only: CI's bandit job runs in an environment without the
+    # project deps installed, where the dependency-audit half would
+    # flag every requirement as missing (pure noise). The full run is
+    # scripts/ci_local.py's, in the real environment.
+    static_only = "--static-only" in sys.argv[1:]
     findings = scan_tree()
     order = {"HIGH": 0, "MEDIUM": 1, "LOW": 2}
     findings.sort(key=lambda f: (order[f.severity], f.path, f.line))
@@ -294,13 +299,14 @@ def main() -> int:
         f"static scan: {len(high)} high, {len(med)} medium, "
         f"{len(low)} low across first-party sources"
     )
-    print()
-    print("== dependency audit (nancy/pip-audit analogue) ==")
-    dep_lines, dep_problems = dependency_audit()
-    for ln in dep_lines:
-        print(ln)
-    for p in dep_problems:
-        print(f"[MEDIUM] dependency: {p}")
+    if not static_only:
+        print()
+        print("== dependency audit (nancy/pip-audit analogue) ==")
+        dep_lines, dep_problems = dependency_audit()
+        for ln in dep_lines:
+            print(ln)
+        for p in dep_problems:
+            print(f"[MEDIUM] dependency: {p}")
 
     if high:
         print(f"security-scan: FAIL ({len(high)} high-severity findings)")

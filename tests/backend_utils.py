@@ -159,3 +159,23 @@ class InProcessBackend:
 
     async def __aexit__(self, *exc) -> None:
         await self.server.stop(grace=None)
+
+
+def reference_middleware_chain(server_cfg, metrics):
+    """The reference's DefaultMiddleware order (middleware.go:280-293)
+    as the composable per-gate factories — shared by the fused-vs-chain
+    equivalence suite and the per-gate chain suite so the order lives
+    in exactly one place."""
+    from ggrmcp_tpu.gateway import middleware as mw
+
+    return [
+        mw.recovery_middleware(),
+        mw.logging_middleware(),
+        mw.security_headers_middleware(server_cfg),
+        mw.cors_middleware(server_cfg),
+        mw.rate_limit_middleware(server_cfg, metrics),
+        mw.content_type_middleware(server_cfg),
+        mw.request_size_middleware(server_cfg),
+        mw.timeout_middleware(server_cfg),
+        mw.metrics_middleware(metrics),
+    ]

@@ -1,0 +1,86 @@
+"""In-process tests for the multi-host runtime wrapper
+(`parallel/distributed.py`) and the co-launch transport decision
+(`serving/launcher.py::resolve_colaunch_transport`).
+
+The real multi-process path is exercised by tests/test_multihost.py
+(two actual processes) and the co-launch by tests/test_colaunch.py —
+both invisible to in-process coverage; these pin the decision logic.
+"""
+
+import jax
+import pytest
+
+from ggrmcp_tpu.core.config import MeshConfig, default
+from ggrmcp_tpu.parallel import distributed
+from ggrmcp_tpu.serving.launcher import resolve_colaunch_transport
+
+
+class TestInitialize:
+    def test_single_process_when_unconfigured(self, monkeypatch):
+        for var in ("GGRMCP_COORDINATOR", "GGRMCP_NUM_PROCESSES",
+                    "GGRMCP_PROCESS_ID"):
+            monkeypatch.delenv(var, raising=False)
+        assert distributed.initialize() is False
+
+    def test_env_autodetection_feeds_jax(self, monkeypatch):
+        seen = {}
+        monkeypatch.setattr(
+            jax.distributed, "initialize",
+            lambda **kw: seen.update(kw),
+        )
+        monkeypatch.setenv("GGRMCP_COORDINATOR", "coord:1234")
+        monkeypatch.setenv("GGRMCP_NUM_PROCESSES", "2")
+        monkeypatch.setenv("GGRMCP_PROCESS_ID", "1")
+        assert distributed.initialize() is True
+        assert seen == {
+            "coordinator_address": "coord:1234",
+            "num_processes": 2,
+            "process_id": 1,
+        }
+
+    def test_explicit_args_beat_env(self, monkeypatch):
+        seen = {}
+        monkeypatch.setattr(
+            jax.distributed, "initialize",
+            lambda **kw: seen.update(kw),
+        )
+        monkeypatch.setenv("GGRMCP_COORDINATOR", "env:1")
+        monkeypatch.setenv("GGRMCP_NUM_PROCESSES", "8")
+        assert distributed.initialize(
+            coordinator_address="flag:2", num_processes=2, process_id=0
+        ) is True
+        assert seen["coordinator_address"] == "flag:2"
+        assert seen["num_processes"] == 2
+
+
+class TestGlobalMesh:
+    def test_covers_all_devices(self):
+        mesh = distributed.global_mesh(MeshConfig(tensor=2, data=0))
+        assert mesh.devices.size == len(jax.devices())
+        assert mesh.shape["tensor"] == 2
+
+
+class TestColaunchTransport:
+    def test_defaults_to_private_uds(self):
+        cfg = default()
+        resolve_colaunch_transport(cfg)
+        assert cfg.serving.uds_path
+        assert "ggrmcp-sidecar" in cfg.serving.uds_path
+
+    def test_pinned_port_stays_tcp(self):
+        cfg = default()
+        cfg.serving.port = 59999  # explicit: something external dials it
+        resolve_colaunch_transport(cfg)
+        assert cfg.serving.uds_path == ""
+
+    def test_explicit_uds_path_wins(self):
+        cfg = default()
+        cfg.serving.uds_path = "/tmp/mine.sock"
+        resolve_colaunch_transport(cfg)
+        assert cfg.serving.uds_path == "/tmp/mine.sock"
+
+    def test_disabled_colaunch_uds(self):
+        cfg = default()
+        cfg.serving.colaunch_uds = False
+        resolve_colaunch_transport(cfg)
+        assert cfg.serving.uds_path == ""

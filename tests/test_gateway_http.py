@@ -14,11 +14,26 @@ from tests.backend_utils import MAGIC_ERROR_USER, InProcessBackend
 
 SESSION_HEADER = "Mcp-Session-Id"
 
+# Every test in this module runs against BOTH http_impl backends (the
+# raw-protocol fastlane and the aiohttp middleware chain): the fastlane
+# exists on the promise that the two serve an identical surface, and
+# only running the same suite against both makes that promise a test
+# invariant rather than a docstring claim.
+_CURRENT_IMPL = {"impl": "fastlane"}
+
+
+@pytest.fixture(params=["fastlane", "aiohttp"], autouse=True)
+def http_impl(request):
+    _CURRENT_IMPL["impl"] = request.param
+    yield request.param
+    _CURRENT_IMPL["impl"] = "fastlane"
+
 
 def gateway_config(**overrides) -> cfgmod.Config:
     cfg = cfgmod.default()
     cfg.server.host = "127.0.0.1"
     cfg.server.port = 0
+    cfg.server.http_impl = _CURRENT_IMPL["impl"]
     cfg.grpc.connect_timeout_s = 5.0
     cfg.grpc.reconnect.enabled = False
     for key, value in overrides.items():
@@ -363,19 +378,9 @@ class TestFusedChainEquivalence:
 
     @staticmethod
     def _chained_app_middlewares(cfg, metrics):
-        from ggrmcp_tpu.gateway import middleware as mw
+        from tests.backend_utils import reference_middleware_chain
 
-        return [
-            mw.recovery_middleware(),
-            mw.logging_middleware(),
-            mw.security_headers_middleware(cfg.server),
-            mw.cors_middleware(cfg.server),
-            mw.rate_limit_middleware(cfg.server, metrics),
-            mw.content_type_middleware(cfg.server),
-            mw.request_size_middleware(cfg.server),
-            mw.timeout_middleware(cfg.server),
-            mw.metrics_middleware(metrics),
-        ]
+        return reference_middleware_chain(cfg.server, metrics)
 
     async def _probe(self, client):
         """Drive one request per middleware concern; return comparable
