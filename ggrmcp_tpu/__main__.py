@@ -14,6 +14,12 @@ import sys
 
 from ggrmcp_tpu.core import config as cfgmod
 
+# One source of truth for the subcommand names: build_parser registers
+# exactly these, and main's bare-flags rewrite checks against them
+# (argparse keeps its choices in private attributes with no stability
+# guarantee, so they are not derived from the parser).
+SUBCOMMANDS = ("gateway", "train", "sidecar")
+
 
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
@@ -21,7 +27,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sub = parser.add_subparsers(dest="command")
 
-    gw = sub.add_parser("gateway", help="run the MCP gateway")
+    gw = sub.add_parser(SUBCOMMANDS[0], help="run the MCP gateway")
     gw.add_argument("--grpc-host", default=None, help="backend gRPC host")
     gw.add_argument("--grpc-port", type=int, default=None, help="backend gRPC port")
     gw.add_argument("--http-port", type=int, default=None, help="HTTP listen port")
@@ -60,7 +66,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="gateway worker processes sharing the port (SO_REUSEPORT)",
     )
 
-    tr = sub.add_parser("train", help="fine-tune a model (checkpoint/resume)")
+    tr = sub.add_parser(SUBCOMMANDS[1], help="fine-tune a model (checkpoint/resume)")
     tr.add_argument("--model", default=None, help="model registry key")
     tr.add_argument("--steps", type=int, default=None)
     tr.add_argument("--batch-size", type=int, default=None)
@@ -79,7 +85,7 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--config", default=None, help="YAML/JSON config file")
     tr.add_argument("--log-level", default=None)
 
-    sc = sub.add_parser("sidecar", help="run the TPU serving sidecar only")
+    sc = sub.add_parser(SUBCOMMANDS[2], help="run the TPU serving sidecar only")
     sc.add_argument("--port", type=int, default=None, help="gRPC listen port")
     sc.add_argument("--model", default=None, help="model registry key")
     sc.add_argument(
@@ -137,12 +143,8 @@ def main(argv: list[str] | None = None) -> int:
     parser = build_parser()
     # Reference-CLI compatibility (cmd/grmcp has no subcommands): bare
     # flags imply `gateway`. This must happen BEFORE parsing — argparse
-    # rejects unknown top-level flags, so a post-parse retry never
-    # runs. Known subcommands come from the parser itself.
-    subcommands = next(
-        a.choices.keys() for a in parser._subparsers._group_actions
-    )
-    if argv and argv[0] not in (*subcommands, "-h", "--help"):
+    # rejects unknown top-level flags, so a post-parse retry never runs.
+    if argv and argv[0] not in (*SUBCOMMANDS, "-h", "--help"):
         argv = ["gateway", *argv]
     args = parser.parse_args(argv)
     if args.command == "train":

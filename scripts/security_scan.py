@@ -280,11 +280,17 @@ def dependency_audit() -> tuple[list[str], list[str]]:
 
 
 def main() -> int:
-    # --static-only: CI's bandit job runs in an environment without the
-    # project deps installed, where the dependency-audit half would
-    # flag every requirement as missing (pure noise). The full run is
-    # scripts/ci_local.py's, in the real environment.
-    static_only = "--static-only" in sys.argv[1:]
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--static-only", action="store_true",
+        help="skip the dependency audit (for CI jobs that don't "
+        "install the project deps, where it would be all noise); "
+        "the full run is scripts/ci_local.py's",
+    )
+    args = parser.parse_args()
+    static_only = args.static_only
     findings = scan_tree()
     order = {"HIGH": 0, "MEDIUM": 1, "LOW": 2}
     findings.sort(key=lambda f: (order[f.severity], f.path, f.line))

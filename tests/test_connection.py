@@ -1,0 +1,108 @@
+"""Direct tests for the single-channel manager (`rpc/connection.py`) —
+the connection.go state-machine parity layer under the discoverer's
+backend pool. Previously covered only incidentally through discovery.
+"""
+
+import asyncio
+
+import grpc
+import pytest
+
+from ggrmcp_tpu.core.config import GRPCConfig
+from ggrmcp_tpu.rpc.connection import ChannelManager, _channel_options
+from tests.backend_utils import InProcessBackend
+
+
+def test_channel_options_mirror_config():
+    cfg = GRPCConfig()
+    cfg.max_message_bytes = 1234
+    cfg.keepalive.time_s = 7.0
+    cfg.keepalive.timeout_s = 3.0
+    cfg.keepalive.permit_without_stream = True
+    opts = dict(_channel_options(cfg))
+    assert opts["grpc.max_send_message_length"] == 1234
+    assert opts["grpc.max_receive_message_length"] == 1234
+    assert opts["grpc.keepalive_time_ms"] == 7000
+    assert opts["grpc.keepalive_timeout_ms"] == 3000
+    assert opts["grpc.keepalive_permit_without_calls"] == 1
+
+
+class TestConnect:
+    async def test_connect_and_health(self):
+        async with InProcessBackend() as backend:
+            mgr = ChannelManager(backend.target)
+            try:
+                channel = await mgr.connect()
+                assert channel is mgr.channel
+                assert mgr.is_connected()
+                assert await mgr.health_check() is True
+            finally:
+                await mgr.close()
+
+    async def test_connect_timeout_leaves_disconnected(self):
+        # RFC 5737 TEST-NET: unroutable, so channel_ready can't succeed
+        mgr = ChannelManager("192.0.2.1:1")
+        with pytest.raises(ConnectionError, match="timed out"):
+            await mgr.connect(timeout_s=0.2)
+        assert not mgr.is_connected()
+        with pytest.raises(ConnectionError, match="not connected"):
+            _ = mgr.channel
+        await mgr.close()
+
+    async def test_reconnect_replaces_channel(self):
+        async with InProcessBackend() as backend:
+            mgr = ChannelManager(backend.target)
+            try:
+                first = await mgr.connect()
+                second = await mgr.reconnect()
+                assert second is mgr.channel and second is not first
+                assert mgr.is_connected()
+            finally:
+                await mgr.close()
+
+
+class TestHealth:
+    async def test_unconnected_reports_unhealthy(self):
+        mgr = ChannelManager("localhost:1")
+        assert mgr.is_connected() is False
+        assert await mgr.health_check() is False
+
+    async def test_dead_backend_fails_health(self, tmp_path):
+        # UDS, not TCP: a freed ephemeral TCP port can be rebound by a
+        # concurrently-running test's backend, resurrecting the "dead"
+        # target mid-assert. Nothing rebinds this socket path.
+        sock = str(tmp_path / "dead.sock")
+        async with InProcessBackend(uds=sock) as backend:
+            mgr = ChannelManager(backend.target)
+            await mgr.connect()
+        try:
+            # The state machine is eventually-consistent (connection.go
+            # parity): a probe racing the connection teardown may still
+            # see READY once. Wait for the drop to be observed, THEN
+            # the probe must fail (and must not hang).
+            channel = mgr.channel
+            state = channel.get_state()
+            deadline = 50
+            while state == grpc.ChannelConnectivity.READY and deadline:
+                try:
+                    await asyncio.wait_for(
+                        channel.wait_for_state_change(state), timeout=0.1
+                    )
+                except asyncio.TimeoutError:
+                    pass
+                state = channel.get_state()
+                deadline -= 1
+            assert state != grpc.ChannelConnectivity.READY
+            assert await mgr.health_check(timeout_s=1.0) is False
+        finally:
+            await mgr.close()
+
+    async def test_close_clears_state(self):
+        async with InProcessBackend() as backend:
+            mgr = ChannelManager(backend.target)
+            await mgr.connect()
+            await mgr.close()
+            assert not mgr.is_connected()
+            with pytest.raises(ConnectionError):
+                _ = mgr.channel
+            await mgr.close()  # idempotent

@@ -19,21 +19,25 @@ SESSION_HEADER = "Mcp-Session-Id"
 # exists on the promise that the two serve an identical surface, and
 # only running the same suite against both makes that promise a test
 # invariant rather than a docstring claim.
-_CURRENT_IMPL = {"impl": "fastlane"}
+_DEFAULT_IMPL = "fastlane"
 
 
 @pytest.fixture(params=["fastlane", "aiohttp"], autouse=True)
-def http_impl(request):
-    _CURRENT_IMPL["impl"] = request.param
-    yield request.param
-    _CURRENT_IMPL["impl"] = "fastlane"
+def http_impl(request, monkeypatch):
+    # monkeypatch guarantees the reset even on error/interrupt, so
+    # cross-module importers of gateway_config (tests/test_fastlane.py)
+    # always see the fastlane default outside this fixture's window.
+    import tests.test_gateway_http as me
+
+    monkeypatch.setattr(me, "_DEFAULT_IMPL", request.param)
+    return request.param
 
 
-def gateway_config(**overrides) -> cfgmod.Config:
+def gateway_config(impl: str | None = None, **overrides) -> cfgmod.Config:
     cfg = cfgmod.default()
     cfg.server.host = "127.0.0.1"
     cfg.server.port = 0
-    cfg.server.http_impl = _CURRENT_IMPL["impl"]
+    cfg.server.http_impl = impl or _DEFAULT_IMPL
     cfg.grpc.connect_timeout_s = 5.0
     cfg.grpc.reconnect.enabled = False
     for key, value in overrides.items():

@@ -1,0 +1,91 @@
+"""Tokenizer plane (`serving/tokenizer.py`): the hermetic byte-level
+default and the HuggingFace-file path (built in-test — no downloaded
+assets in this zero-egress environment).
+"""
+
+import pytest
+
+from ggrmcp_tpu.serving.tokenizer import (
+    ByteTokenizer,
+    HFTokenizer,
+    load_tokenizer,
+)
+
+
+class TestByteTokenizer:
+    def test_roundtrip_ascii_and_unicode(self):
+        tok = ByteTokenizer()
+        for text in ("hello", "héllo wörld", "日本語", "a\x00b"):
+            assert tok.decode(tok.encode(text)) == text
+
+    def test_specials_reserved(self):
+        tok = ByteTokenizer()
+        ids = tok.encode("abc")
+        assert all(i >= tok.OFFSET for i in ids)
+        assert (tok.pad_id, tok.bos_id, tok.eos_id) == (0, 1, 2)
+        # specials and out-of-range ids are dropped, not crashed on
+        assert tok.decode([tok.bos_id, *ids, tok.eos_id, 99999]) == "abc"
+
+    def test_vocab_covers_all_bytes(self):
+        tok = ByteTokenizer()
+        assert tok.vocab_size == 256 + ByteTokenizer.OFFSET
+        everything = bytes(range(256)).decode("latin-1")
+        encoded = tok.encode(everything)
+        assert max(encoded) < tok.vocab_size + 256  # multi-byte utf-8 ok
+
+
+@pytest.fixture(scope="module")
+def hf_tokenizer_file(tmp_path_factory):
+    """A real tokenizers-library file built locally: word-level with
+    llama-style specials."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+
+    tok = Tokenizer(models.WordLevel(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    trainer = trainers.WordLevelTrainer(
+        special_tokens=["<pad>", "<s>", "</s>", "<unk>"]
+    )
+    tok.train_from_iterator(
+        ["the quick brown fox", "jumps over the lazy dog"], trainer
+    )
+    path = tmp_path_factory.mktemp("tok") / "tokenizer.json"
+    tok.save(str(path))
+    return str(path)
+
+
+class TestHFTokenizer:
+    def test_loads_and_roundtrips(self, hf_tokenizer_file):
+        tok = HFTokenizer(hf_tokenizer_file)
+        ids = tok.encode("the quick fox")
+        assert ids and all(isinstance(i, int) for i in ids)
+        assert tok.decode(ids) == "the quick fox"
+
+    def test_special_token_ids_resolved(self, hf_tokenizer_file):
+        tok = HFTokenizer(hf_tokenizer_file)
+        assert tok.pad_id != tok.bos_id != tok.eos_id
+        assert tok.vocab_size > 4
+
+    def test_missing_specials_fall_back_to_defaults(self, tmp_path):
+        from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+
+        tok = Tokenizer(models.WordLevel(unk_token="[UNK]"))
+        tok.pre_tokenizer = pre_tokenizers.Whitespace()
+        tok.train_from_iterator(
+            ["plain words only"],
+            trainers.WordLevelTrainer(special_tokens=["[UNK]"]),
+        )
+        path = tmp_path / "tokenizer.json"
+        tok.save(str(path))
+        wrapped = HFTokenizer(str(path))
+        assert (wrapped.pad_id, wrapped.bos_id, wrapped.eos_id) == (0, 1, 2)
+
+
+class TestLoader:
+    def test_default_is_byte_level(self):
+        assert isinstance(load_tokenizer(""), ByteTokenizer)
+
+    def test_missing_path_falls_back(self):
+        assert isinstance(load_tokenizer("/nope/tokenizer.json"), ByteTokenizer)
+
+    def test_existing_path_uses_hf(self, hf_tokenizer_file):
+        assert isinstance(load_tokenizer(hf_tokenizer_file), HFTokenizer)
