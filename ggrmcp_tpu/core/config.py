@@ -376,7 +376,8 @@ class ServingConfig:
     # window + prefill_chunk - 1 instead of the full context, and
     # generation length is bounded by the model's RoPE range, not KV
     # HBM (docs/kv_ring_design.md). Batcher-path only; incompatible
-    # with kv_tiers, the prefix pool, and pipeline serving.
+    # with kv_tiers and the prefix pool; composes with int8 KV and
+    # pipeline serving (validate() below, tests/test_pp_serving.py).
     kv_ring: bool = False
     # Speculative decoding (greedy/lossless): registry key of a small
     # dense draft model sharing the target's vocab ("" → off). Unary

@@ -79,6 +79,16 @@ CONFIGS: dict[str, MoEConfig] = {
         ffn_dim=14336, max_seq_len=8192, rope_theta=1000000.0,
         num_experts=8, experts_per_token=2,
     ),
+    # Windowed MoE (the Mixtral-8x7B-v0.1 config carried
+    # sliding_window=4096): attention rides the shared windowed
+    # attention_block, so kv_ring serving applies to MoE too — tiny
+    # dims + a 16-key window keep the ring-wrap path CPU-testable.
+    "tiny-moe-sw": MoEConfig(
+        name="tiny-moe-sw", vocab_size=512, hidden_dim=256, num_layers=2,
+        num_heads=8, num_kv_heads=4, head_dim=32, ffn_dim=512,
+        max_seq_len=1024, num_experts=4, experts_per_token=2,
+        sliding_window=16, dtype="float32",
+    ),
 }
 
 

@@ -162,6 +162,46 @@ class TestRingEquivalence:
         assert outs2[0] == expected[0] and outs2[1] == expected[0]
         assert got == exp_short[0]
 
+    async def test_moe_ring_serving_matches_contiguous(self):
+        """Ring serving for the MoE family end-to-end: the registered
+        windowed config (`tiny-moe-sw`, the Mixtral-v0.1 shape) through
+        engine + batcher with kv_ring, wrapping the ring, must match
+        the contiguous windowed generate exactly."""
+        from ggrmcp_tpu.core.config import BatchingConfig, ServingConfig
+        from ggrmcp_tpu.models import moe
+        from ggrmcp_tpu.ops.sampling import SamplingConfig
+        from ggrmcp_tpu.serving.batching import ContinuousBatcher
+        from ggrmcp_tpu.serving.engine import GenerationEngine
+
+        mcfg = moe.CONFIGS["tiny-moe-sw"]
+        engine = GenerationEngine(
+            mcfg,
+            ServingConfig(
+                model="tiny-moe-sw",
+                kv_ring=True,
+                batching=BatchingConfig(max_batch_size=4, prefill_chunk=8),
+            ),
+        )
+        assert engine.ring_capacity == mcfg.sliding_window + 8 - 1
+        ref = GenerationEngine(mcfg, ServingConfig(model="tiny-moe-sw"))
+        prompt = [(i * 11 + 3) % 500 + 1 for i in range(30)]
+        expected, _ = ref.generate([prompt], max_new_tokens=20, seed=0)
+
+        batcher = ContinuousBatcher(
+            engine, BatchingConfig(max_batch_size=4, prefill_chunk=8)
+        )
+        batcher.warmup()
+        batcher.start()
+        try:
+            got: list[int] = []
+            async for ids, _ in batcher.submit(
+                prompt, 20, SamplingConfig(temperature=0.0), seed=0
+            ):
+                got.extend(ids)
+        finally:
+            await batcher.stop()
+        assert got == expected[0]
+
     def test_config_and_engine_rejections(self):
         from ggrmcp_tpu.core import config as cfgmod
         from ggrmcp_tpu.core.config import MeshConfig, ServingConfig
@@ -205,13 +245,9 @@ class TestRingEquivalence:
         """The MoE family shares the attention trunk; a windowed MoE
         config must produce identical logits through a ring cache
         (beyond capacity) and a contiguous one."""
-        import dataclasses
-
         from ggrmcp_tpu.models import moe
 
-        mcfg = dataclasses.replace(
-            moe.CONFIGS["tiny-moe"], sliding_window=16
-        )
+        mcfg = moe.CONFIGS["tiny-moe-sw"]
         mparams = moe.init_params(jax.random.PRNGKey(4), mcfg)
         chunks = schedule(48, 8, seed=11)
 

@@ -157,10 +157,18 @@ class TestPPRing:
     """Ring-buffer KV under pipeline serving (round-3 compat close):
     the staged forward threads `ring` into each stage's layer block, so
     sliding-window models serve pipelined with window-bounded KV HBM —
-    the big-model Mistral story the r2 exclusion carved out."""
+    the big-model Mistral story the r2 exclusion carved out.
 
+    Parametrized over the KV dtype: kv_cache_dtype="int8" is the
+    TRIPLE composition (ring layout × int8 cache blocks × staged tick
+    schedule slicing QuantizedArray leaves). Each pair is pinned
+    elsewhere (test_kv_ring int8×ring, TestPPInt8KV int8×PP); both
+    variants must match a single-device engine with the same KV dtype
+    exactly — layout and staging change memory movement, not values."""
+
+    @pytest.mark.parametrize("kv_dtype", ["", "int8"])
     async def test_ring_batcher_on_pp_mesh_matches_single_device(
-        self, pp_mesh
+        self, pp_mesh, kv_dtype
     ):
         from ggrmcp_tpu.serving.batching import ContinuousBatcher
 
@@ -171,6 +179,7 @@ class TestPPRing:
                 model="tiny-mistral",
                 mesh=MeshConfig(stage=2, tensor=2, data=0),
                 kv_ring=True,
+                kv_cache_dtype=kv_dtype,
                 batching=BatchingConfig(max_batch_size=4, prefill_chunk=8),
             ),
             mesh=pp_mesh,
@@ -178,7 +187,7 @@ class TestPPRing:
         assert eng.pp_serving and eng.ring_capacity == 16 + 8 - 1
         ref = GenerationEngine(
             mcfg,
-            ServingConfig(model="tiny-mistral"),
+            ServingConfig(model="tiny-mistral", kv_cache_dtype=kv_dtype),
             mesh=mesh_mod.build_mesh(MeshConfig(tensor=1), jax.devices()[:1]),
         )
         # 30-token prompt + 20 new = 50 >> ring capacity 23: decode

@@ -159,3 +159,53 @@ async def test_sidecar_with_tiers():
 # Heavy JAX-compile/serving integration module: excluded from the
 # fast `make test` signal; always in `make test-all` / CI.
 pytestmark = pytest.mark.slow
+
+
+async def test_tiers_on_pp_mesh_match_single_device():
+    """Tiers × pipeline stages: each tier's ContinuousBatcher drives
+    the staged cached forward; tier routing must not disturb greedy
+    output vs an unstaged single-device engine."""
+    import jax
+
+    from ggrmcp_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.build_mesh(
+        MeshConfig(stage=2, tensor=2, data=0), jax.devices()[:4]
+    )
+    bcfg = BatchingConfig(
+        max_batch_size=4, kv_tiers=TIERS, max_queue_delay_ms=1.0,
+        prefill_chunk=32,
+    )
+    pp = GenerationEngine(
+        llama.CONFIGS["tiny-llama"],
+        ServingConfig(
+            model="tiny-llama",
+            mesh=MeshConfig(stage=2, tensor=2, data=0),
+            batching=bcfg,
+        ),
+        mesh=mesh,
+    )
+    assert pp.pp_serving
+    ref = GenerationEngine(
+        llama.CONFIGS["tiny-llama"],
+        ServingConfig(model="tiny-llama"),
+        mesh=mesh_mod.build_mesh(MeshConfig(tensor=1), jax.devices()[:1]),
+    )
+    short = [5, 3, 8]
+    long = [(i * 7 + 3) % 500 + 1 for i in range(100)]
+    exp_short, _ = ref.generate([short], max_new_tokens=5, seed=0)
+    exp_long, _ = ref.generate([long], max_new_tokens=5, seed=0)
+
+    tiered = TieredBatcher(pp, bcfg)
+    tiered.warmup()
+    tiered.start()
+    try:
+        for prompt, expected in ((short, exp_short[0]), (long, exp_long[0])):
+            out: list[int] = []
+            async for ids, _reason in tiered.submit(
+                prompt, 5, SamplingConfig(temperature=0.0), seed=0
+            ):
+                out.extend(ids)
+            assert out == expected
+    finally:
+        await tiered.stop()
