@@ -235,7 +235,8 @@ def attention_block(
     """Pre-norm GQA attention with residual; shared by the dense and MoE
     decoder families. Returns (x + attn, (cache_k, cache_v) or None).
     K/V keep their KV heads — GQA lives in ops.attention (the flash
-    kernel reads shared heads in place; the XLA path repeats them).
+    kernel reads shared heads in place; the XLA path contracts
+    grouped for decode and repeats only for long queries).
 
     `ring=True` (sliding-window serving): the cache's sequence dim is a
     RING of capacity C — writes land at `pos % C` and attention masks

