@@ -70,6 +70,13 @@ class _Request:
     seed: int
     out: asyncio.Queue = dataclasses.field(default_factory=asyncio.Queue)
     cancelled: bool = False
+    # Unary consumers want ONE terminal chunk: per-tick emission costs
+    # a cross-thread call_soon_threadsafe + queue put + consumer wakeup
+    # per slot per tick — at batch 16 that is 16x the loop events the
+    # result needs. Tokens accumulate in `acc` (executor-thread-only
+    # until the terminal emit) and post once on finish.
+    unary: bool = False
+    acc: list[int] = dataclasses.field(default_factory=list)
 
 
 class ContinuousBatcher:
@@ -707,9 +714,13 @@ class ContinuousBatcher:
         max_new: int,
         sampling: SamplingConfig,
         seed: int = 0,
+        unary: bool = False,
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         """Enqueue a request; yields (token_ids_chunk, finish_reason)
-        pairs; finish_reason is set on the final chunk."""
+        pairs; finish_reason is set on the final chunk. `unary=True`
+        (non-streaming consumers): one terminal chunk with all tokens —
+        same iterator contract, a fraction of the cross-thread events
+        (see _Request.unary)."""
         # Reserve steps_per_tick-1 cache slots: a tick may overshoot a
         # slot's max_new by up to that many positions before the host
         # masks the extra tokens.
@@ -717,7 +728,8 @@ class ContinuousBatcher:
             prompt, max_new, self._fit_limit - (self._steps_per_tick - 1)
         )
         request = _Request(
-            prompt=prompt, max_new=max_new, sampling=sampling, seed=seed
+            prompt=prompt, max_new=max_new, sampling=sampling, seed=seed,
+            unary=unary,
         )
         await self.pending.put(request)
         self._wake.set()
@@ -1012,11 +1024,19 @@ class ContinuousBatcher:
         if request.cancelled:
             finished_reason = finished_reason or "cancelled"
             ids = []
-        # Runs on executor threads; asyncio.Queue is not thread-safe,
-        # so hop through the loop.
-        self._loop_ref.call_soon_threadsafe(
-            request.out.put_nowait, (ids, finished_reason)
-        )
+        if request.unary:
+            request.acc.extend(ids)
+            if finished_reason is not None:
+                self._loop_ref.call_soon_threadsafe(
+                    request.out.put_nowait,
+                    (request.acc, finished_reason),
+                )
+        else:
+            # Runs on executor threads; asyncio.Queue is not
+            # thread-safe, so hop through the loop.
+            self._loop_ref.call_soon_threadsafe(
+                request.out.put_nowait, (ids, finished_reason)
+            )
         if finished_reason is not None:
             slot.active = False
             slot.request = None

@@ -212,8 +212,10 @@ class Sidecar:
                     logger.exception("speculative generation failed")
                     finish = "error"
             else:
+                # unary: one terminal chunk — skips per-tick
+                # cross-thread emission (batching.py _Request.unary).
                 async for chunk_ids, reason in self.batcher.submit(
-                    prompt, max_new, sampling, seed
+                    prompt, max_new, sampling, seed, unary=True
                 ):
                     token_ids.extend(chunk_ids)
                     if reason:

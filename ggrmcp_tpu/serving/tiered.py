@@ -85,9 +85,10 @@ class TieredBatcher:
         max_new: int,
         sampling: SamplingConfig,
         seed: int = 0,
+        unary: bool = False,
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         return self._route(len(prompt), max_new).submit(
-            prompt, max_new, sampling, seed
+            prompt, max_new, sampling, seed, unary=unary
         )
 
     def cache_bytes(self) -> int:

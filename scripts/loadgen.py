@@ -99,12 +99,27 @@ async def run(args: argparse.Namespace) -> dict:
     url = urlsplit(args.base_url)
     host, port = url.hostname, url.port
     hostport = f"{host}:{port}"
-    body_bytes = json.dumps({
-        "jsonrpc": "2.0",
-        "method": "tools/call",
-        "id": 1,
-        "params": {"name": args.tool, "arguments": json.loads(args.arguments)},
-    }).encode()
+
+    def body_for(s: int, i: int) -> bytes:
+        """Per-call body. Fixed --arguments traffic precomputes one
+        byte-string (the proxy bench's hot path); --arguments-template
+        substitutes {s} (session), {i} (call), {seed} (s*7919+i) per
+        call — model-generate traffic needs distinct prompts/seeds, and
+        one json.dumps per call is noise next to a generate."""
+        arguments = json.loads(
+            args.arguments_template
+            .replace("{s}", str(s)).replace("{i}", str(i))
+            .replace("{seed}", str(s * 7919 + i))
+            if args.arguments_template else args.arguments
+        )
+        return json.dumps({
+            "jsonrpc": "2.0",
+            "method": "tools/call",
+            "id": s * 100000 + i,
+            "params": {"name": args.tool, "arguments": arguments},
+        }).encode()
+
+    fixed_body = None if args.arguments_template else body_for(0, 0)
     latencies: list[float] = []
 
     async def one_call(
@@ -117,7 +132,11 @@ async def run(args: argparse.Namespace) -> dict:
         proto.waiter = waiter
         proto.transport.write(request)
         head, payload = await waiter
-        if not head.startswith(b"HTTP/1.1 200") or b'"error"' in payload:
+        if (
+            not head.startswith(b"HTTP/1.1 200")
+            or b'"error"' in payload
+            or b'"isError"' in payload
+        ):
             raise RuntimeError(
                 f"call failed ({head[:15]!r}): {payload[:200]!r}"
             )
@@ -125,29 +144,36 @@ async def run(args: argparse.Namespace) -> dict:
             latencies.append((time.perf_counter() - t) * 1000.0)
         return head
 
-    async def session_worker(calls: int, record: bool) -> None:
+    async def session_worker(s: int, calls: int, record: bool) -> None:
         transport, proto = await loop.create_connection(
             _ClientProtocol, host, port
         )
         try:
             # First call mints the session; reuse it like a real MCP
             # client (steady-state hot path, not per-call minting).
-            request = build_request(hostport, body_bytes)
-            head = await one_call(proto, record, request)
+            body = fixed_body if fixed_body is not None else body_for(s, 0)
+            head = await one_call(proto, record, build_request(hostport, body))
             sid = ""
             lower = head.lower()
             idx = lower.find(b"mcp-session-id:")
             if idx >= 0:
                 eol = lower.find(b"\r\n", idx)
                 sid = head[idx + 15: eol if eol >= 0 else len(head)].strip().decode()
-            request = build_request(hostport, body_bytes, sid)
-            for _ in range(calls - 1):
-                await one_call(proto, record, request)
+            if fixed_body is not None:
+                request = build_request(hostport, fixed_body, sid)
+                for _ in range(calls - 1):
+                    await one_call(proto, record, request)
+            else:
+                for i in range(1, calls):
+                    await one_call(
+                        proto, record,
+                        build_request(hostport, body_for(s, i), sid),
+                    )
         finally:
             transport.close()
 
-    for _ in range(args.warmup):
-        await session_worker(1, record=False)
+    for w in range(args.warmup):
+        await session_worker(1000 + w, 1, record=False)
 
     print("READY", flush=True)
     line = await loop.run_in_executor(None, sys.stdin.readline)
@@ -157,8 +183,8 @@ async def run(args: argparse.Namespace) -> dict:
     start = time.time()
     await asyncio.gather(
         *(
-            session_worker(args.calls_per_session, record=True)
-            for _ in range(args.sessions)
+            session_worker(s, args.calls_per_session, record=True)
+            for s in range(args.sessions)
         )
     )
     end = time.time()
@@ -176,6 +202,11 @@ def main() -> None:
     parser.add_argument("--base-url", required=True)
     parser.add_argument("--tool", required=True)
     parser.add_argument("--arguments", default="{}")
+    parser.add_argument(
+        "--arguments-template", default="",
+        help="per-call arguments JSON with {s}/{i}/{seed} placeholders "
+        "(distinct-prompt generate traffic); overrides --arguments",
+    )
     parser.add_argument("--sessions", type=int, default=8)
     parser.add_argument("--calls-per-session", type=int, default=100)
     parser.add_argument("--warmup", type=int, default=4)
