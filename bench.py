@@ -179,6 +179,71 @@ def _claim_output(who: str = "main") -> bool:
         return True
 
 
+async def _drive_loadgens(
+    argv_list: list[list[str]],
+    *,
+    ready_timeout: float,
+    run_timeout: float,
+    capture_stderr: bool,
+    label: str,
+) -> list[dict]:
+    """Spawn scripts/loadgen.py processes, run the READY/GO handshake,
+    and return their result dicts. The one loadgen wire-protocol driver
+    for every phase (headline + proxy): kills survivors on any failure,
+    and surfaces the generator's stderr when captured instead of an
+    opaque JSONDecodeError on an empty line."""
+
+    async def _err(g) -> str:
+        if not capture_stderr:
+            return ""
+        return (await g.stderr.read()).decode(errors="replace")
+
+    gens = []
+    try:
+        for argv in argv_list:
+            gens.append(await asyncio.create_subprocess_exec(
+                *argv,
+                stdin=asyncio.subprocess.PIPE,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=(
+                    asyncio.subprocess.PIPE if capture_stderr
+                    else asyncio.subprocess.DEVNULL
+                ),
+                # The result line carries every latency sample; the
+                # default 64 KiB StreamReader limit truncates big runs.
+                limit=32 * 1024 * 1024,
+            ))
+        for g in gens:
+            ready = await asyncio.wait_for(
+                g.stdout.readline(), timeout=ready_timeout
+            )
+            if ready.decode().strip() != "READY":
+                raise RuntimeError(
+                    f"{label} loadgen not ready: {ready!r} "
+                    f"{(await _err(g))[-400:]}"
+                )
+        for g in gens:
+            g.stdin.write(b"GO\n")
+            await g.stdin.drain()
+        results = []
+        for g in gens:
+            out = await asyncio.wait_for(
+                g.stdout.readline(), timeout=run_timeout
+            )
+            if not out.strip():
+                raise RuntimeError(
+                    f"{label} loadgen died without a result: "
+                    f"{(await _err(g))[-500:]}"
+                )
+            results.append(json.loads(out))
+            await g.wait()
+        return results
+    finally:
+        for g in gens:
+            if g.returncode is None:
+                g.kill()
+
+
 async def _run_bench() -> dict:
     import logging
 
@@ -312,40 +377,19 @@ async def _run_bench() -> dict:
             "maxNewTokens": max_new,
             "sampling": {"temperature": 0.7, "seed": "{seed}"},
         })
-        gen = await asyncio.create_subprocess_exec(
-            sys.executable, os.path.join(repo, "scripts", "loadgen.py"),
-            "--base-url", base,
-            "--tool", tool,
-            "--arguments-template", template,
-            "--sessions", str(sessions),
-            "--calls-per-session", str(calls_per_session),
-            "--warmup", "2",
-            stdin=asyncio.subprocess.PIPE,
-            stdout=asyncio.subprocess.PIPE,
-            stderr=asyncio.subprocess.PIPE,
-            limit=32 * 1024 * 1024,
+        [gen_result] = await _drive_loadgens(
+            [[
+                sys.executable, os.path.join(repo, "scripts", "loadgen.py"),
+                "--base-url", base,
+                "--tool", tool,
+                "--arguments-template", template,
+                "--sessions", str(sessions),
+                "--calls-per-session", str(calls_per_session),
+                "--warmup", "2",
+            ]],
+            ready_timeout=300, run_timeout=3600,
+            capture_stderr=True, label="headline",
         )
-        try:
-            ready = await asyncio.wait_for(gen.stdout.readline(), timeout=300)
-            if ready.decode().strip() != "READY":
-                err = (await gen.stderr.read()).decode(errors="replace")
-                raise RuntimeError(f"loadgen not ready: {ready!r} {err[-400:]}")
-            gen.stdin.write(b"GO\n")
-            await gen.stdin.drain()
-            out = await asyncio.wait_for(gen.stdout.readline(), timeout=3600)
-            if not out.strip():
-                # loadgen died mid-run (e.g. a call failed): its
-                # traceback went to the stderr pipe — surface it, not
-                # an opaque JSONDecodeError on an empty line.
-                err = (await gen.stderr.read()).decode(errors="replace")
-                raise RuntimeError(
-                    f"headline loadgen died without a result: {err[-500:]}"
-                )
-            gen_result = json.loads(out)
-            await gen.wait()
-        finally:
-            if gen.returncode is None:
-                gen.kill()
         elapsed = gen_result["end"] - gen_result["start"]
         total = gen_result["count"]
         latencies = sorted(gen_result["latencies_ms"])
@@ -706,41 +750,20 @@ async def _proxy_bench() -> dict:
     per_session = max(1, total // (procs * sess_per_proc))
 
     async def run_wave() -> tuple[float, list[float]]:
-        gens = []
-        try:
-            for _ in range(procs):
-                gens.append(await asyncio.create_subprocess_exec(
-                    sys.executable, os.path.join(repo, "scripts", "loadgen.py"),
-                    "--base-url", f"http://127.0.0.1:{gateway.port}",
-                    "--tool", "hello_helloservice_sayhello",
-                    "--arguments", '{"name": "bench"}',
-                    "--sessions", str(sess_per_proc),
-                    "--calls-per-session", str(per_session),
-                    "--warmup", "4",
-                    stdin=asyncio.subprocess.PIPE,
-                    stdout=asyncio.subprocess.PIPE,
-                    stderr=asyncio.subprocess.DEVNULL,
-                    # The result line carries every latency sample; the
-                    # default 64 KiB StreamReader limit truncates big
-                    # runs.
-                    limit=32 * 1024 * 1024,
-                ))
-            for g in gens:
-                ready = await asyncio.wait_for(g.stdout.readline(), timeout=60)
-                if ready.decode().strip() != "READY":
-                    raise RuntimeError(f"loadgen not ready: {ready!r}")
-            for g in gens:
-                g.stdin.write(b"GO\n")
-                await g.stdin.drain()
-            results = []
-            for g in gens:
-                out = await asyncio.wait_for(g.stdout.readline(), timeout=300)
-                results.append(json.loads(out))
-                await g.wait()
-        finally:
-            for g in gens:
-                if g.returncode is None:
-                    g.kill()
+        argv = [
+            sys.executable, os.path.join(repo, "scripts", "loadgen.py"),
+            "--base-url", f"http://127.0.0.1:{gateway.port}",
+            "--tool", "hello_helloservice_sayhello",
+            "--arguments", '{"name": "bench"}',
+            "--sessions", str(sess_per_proc),
+            "--calls-per-session", str(per_session),
+            "--warmup", "4",
+        ]
+        results = await _drive_loadgens(
+            [argv] * procs,
+            ready_timeout=60, run_timeout=300,
+            capture_stderr=False, label="proxy",
+        )
         latencies = [ms for r in results for ms in r["latencies_ms"]]
         count = sum(r["count"] for r in results)
         elapsed = (

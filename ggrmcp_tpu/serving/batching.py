@@ -170,8 +170,11 @@ class ContinuousBatcher:
         self.prefix_hits = 0
         self.prefix_misses = 0
 
-        # jitted: one decode tick for the whole slot pool
-        self._tick = jax.jit(self._tick_impl, donate_argnums=(1,))
+        # jitted: one decode tick for the whole slot pool (params ride
+        # as an argument — a closed-over weight tree would be lowered
+        # into the module as constants, bloating compiles and defeating
+        # the persistent compile cache; see DecoderEngine.__init__)
+        self._tick = jax.jit(self._tick_impl, donate_argnums=(2,))
         # jitted admission — fused prefill + first-token sample + cache
         # merge, ONE device call per admission round. Exactly two row
         # shapes compile per sequence bucket (predictable cold-start):
@@ -255,7 +258,9 @@ class ContinuousBatcher:
         lengths = jnp.where(valid, true_len, cache.length)
         return first, llama_mod.KVCache(k=k, v=v, length=lengths)
 
-    def _tick_impl(self, tokens, cache, seeds, step, temps, ks, ps, active):
+    def _tick_impl(
+        self, params, tokens, cache, seeds, step, temps, ks, ps, active
+    ):
         """One device call = `decode_steps_per_tick` fused decode steps
         (lax.scan). Fewer host round-trips per token: tokens sampled
         after a slot's EOS/max_new are dropped host-side in
@@ -265,7 +270,7 @@ class ContinuousBatcher:
         def body(carry, i):
             cur, cache = carry
             logits, cache = self.engine.decode_forward(
-                self.engine.params, cur[:, None], cache,
+                params, cur[:, None], cache,
                 valid=active[:, None] if self._is_moe else None,
                 ring=self._ring,
             )
@@ -630,7 +635,7 @@ class ContinuousBatcher:
             jnp.asarray(np.ones((b,), np.float32)),
         )
         _, self.cache = self._tick(
-            jnp.asarray(self.cur_tokens), self.cache,
+            self.engine.params, jnp.asarray(self.cur_tokens), self.cache,
             jnp.asarray(self.seeds), jnp.int32(0),
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
             jnp.asarray(self.top_ps),
@@ -990,7 +995,7 @@ class ContinuousBatcher:
         self.step_counter += self._steps_per_tick
         active = np.array([s.active for s in self.slots], bool)
         toks, self.cache = self._tick(
-            jnp.asarray(self.cur_tokens), self.cache,
+            self.engine.params, jnp.asarray(self.cur_tokens), self.cache,
             jnp.asarray(self.seeds), jnp.int32(step0 + 1),
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
             jnp.asarray(self.top_ps), jnp.asarray(active),

@@ -203,16 +203,24 @@ class GenerationEngine:
             if self.serving.quantize:
                 params = self._quantize_params(params)
         self.params = params
+        # Weights ride as explicit jit ARGUMENTS, never closure
+        # captures: a closed-over param tree is embedded into the
+        # lowered module as constants (jax warns past 2 GB — llama3-8b
+        # int8 is 8 GB of HLO), which bloats compile time/memory and
+        # keys the persistent compile cache on weight VALUES, so no
+        # cache hit ever lands across processes. As arguments the
+        # executable is weight-independent and the cache key is shapes
+        # + shardings only.
         self._prefill_fn = jax.jit(
-            self._prefill_impl, donate_argnums=(2,), static_argnums=()
+            self._prefill_impl, donate_argnums=(3,), static_argnums=()
         )
         self._decode_fn = jax.jit(
-            self._decode_impl, donate_argnums=(1,), static_argnums=(4,)
+            self._decode_impl, donate_argnums=(2,), static_argnums=(5,)
         )
-        # bound method: args are (tokens, true_len, max_new, sampling,
-        # rng, eos_id) — max_new and sampling are static.
+        # bound method: args are (params, tokens, true_len, max_new,
+        # sampling, rng, eos_id) — max_new and sampling are static.
         self._generate_fn = jax.jit(
-            self._generate_impl, static_argnums=(2, 3)
+            self._generate_impl, static_argnums=(3, 4)
         )
         self._init_speculative(seed)
 
@@ -387,14 +395,17 @@ class GenerationEngine:
                 self.draft_fam.param_specs(dcfg), self.mesh,
                 jax.random.PRNGKey(seed + 1),
             )
-        self._spec_fn = jax.jit(self._spec_impl, static_argnums=(2,))
+        self._spec_fn = jax.jit(self._spec_impl, static_argnums=(4,))
 
-    def _spec_impl(self, tokens, true_len, max_new_budget: int, max_new, eos_id):
+    def _spec_impl(
+        self, params, draft_params, tokens, true_len, max_new_budget: int,
+        max_new, eos_id,
+    ):
         from ggrmcp_tpu.ops.speculative import speculative_generate
 
         return speculative_generate(
-            self.fam, self.params, self.cfg,
-            self.draft_fam, self.draft_params, self.draft_cfg,
+            self.fam, params, self.cfg,
+            self.draft_fam, draft_params, self.draft_cfg,
             tokens, true_len, max_new_budget,
             self.serving.speculative_gamma, eos_id, max_new=max_new,
             use_flash=self.use_flash, flash_mesh=self.flash_mesh,
@@ -409,6 +420,7 @@ class GenerationEngine:
         s = bucket_len(1, maximum=self.cfg.max_seq_len)
         with self.mesh:
             res = self._spec_fn(
+                self.params, self.draft_params,
                 jnp.zeros((1, s), jnp.int32), jnp.ones((1,), jnp.int32),
                 max_new_budget, jnp.int32(1), jnp.int32(2),
             )
@@ -512,7 +524,7 @@ class GenerationEngine:
 
     # -- jitted bodies ------------------------------------------------------
 
-    def _prefill_impl(self, tokens, true_len, cache):
+    def _prefill_impl(self, params, tokens, true_len, cache):
         """tokens [B,S] right-padded; true_len [B]. Returns
         (last_logits [B,V], cache with length=true_len). Fresh-prefill
         only (cache length 0) — dispatches through prefill_forward so
@@ -521,7 +533,7 @@ class GenerationEngine:
         # is batch-global); dense forwards are pad-invariant already.
         valid = jnp.arange(tokens.shape[1])[None, :] < true_len[:, None]
         logits, cache = self.prefill_forward(
-            self.params, tokens, cache, valid=valid
+            params, tokens, cache, valid=valid
         )
         idx = jnp.maximum(true_len - 1, 0)
         last = jnp.take_along_axis(
@@ -530,23 +542,25 @@ class GenerationEngine:
         cache = cache._replace(length=true_len)
         return last, cache
 
-    def _decode_impl(self, tokens, cache, rng, step, sampling: SamplingConfig):
+    def _decode_impl(
+        self, params, tokens, cache, rng, step, sampling: SamplingConfig
+    ):
         """tokens [B,1] → (next [B], cache)."""
-        logits, cache = self.decode_forward(self.params, tokens, cache)
+        logits, cache = self.decode_forward(params, tokens, cache)
         key = jax.random.fold_in(rng, step)
         next_tok = sample(logits[:, -1], key, sampling)
         return next_tok, cache
 
     def _generate_impl(
-        self, tokens, true_len, max_new: int, sampling: SamplingConfig, rng,
-        eos_id,
+        self, params, tokens, true_len, max_new: int,
+        sampling: SamplingConfig, rng, eos_id,
     ):
         """Fused prefill + scan-decode. Returns (out_tokens [B, max_new],
         out_len [B])."""
         b = tokens.shape[0]
         max_cache = tokens.shape[1] + max_new
         cache = llama_mod.KVCache.create(self.cfg, b, max_cache, self.kv_dtype)
-        last_logits, cache = self._prefill_impl(tokens, true_len, cache)
+        last_logits, cache = self._prefill_impl(params, tokens, true_len, cache)
         key0 = jax.random.fold_in(rng, 0)
         first = sample(last_logits, key0, sampling)  # [B]
         done0 = first == eos_id
@@ -554,7 +568,7 @@ class GenerationEngine:
         def step(carry, i):
             cur, cache, done = carry
             logits, cache = self.decode_forward(
-                self.params, cur[:, None], cache
+                params, cur[:, None], cache
             )
             key = jax.random.fold_in(rng, i + 1)
             nxt = sample(logits[:, -1], key, sampling)
@@ -663,7 +677,7 @@ class GenerationEngine:
         )
         with self.mesh:
             out, out_len = self._generate_fn(
-                jnp.asarray(tokens), jnp.asarray(true_len),
+                self.params, jnp.asarray(tokens), jnp.asarray(true_len),
                 max_new_tokens, sampling,
                 jax.random.PRNGKey(seed), jnp.int32(eos_id),
             )
@@ -692,6 +706,7 @@ class GenerationEngine:
         budget = bucket_len(max_new_tokens, minimum=8, maximum=limit)
         with self.mesh:
             res = self._spec_fn(
+                self.params, self.draft_params,
                 jnp.asarray(tokens), jnp.asarray(true_len),
                 budget, jnp.int32(max_new_tokens), jnp.int32(eos_id),
             )
@@ -732,7 +747,7 @@ class GenerationEngine:
         with self.mesh:
             cache = self.make_cache(1, max_cache)
             last_logits, cache = self._prefill_fn(
-                jnp.asarray(tokens), jnp.asarray(true_len), cache
+                self.params, jnp.asarray(tokens), jnp.asarray(true_len), cache
             )
             cur = sample(last_logits, jax.random.fold_in(rng, 0),
                          sampling)
@@ -744,7 +759,7 @@ class GenerationEngine:
                 if i == max_new_tokens - 1:
                     return
                 cur, cache = self._decode_fn(
-                    cur[:, None], cache, rng, i + 1, sampling
+                    self.params, cur[:, None], cache, rng, i + 1, sampling
                 )
 
     def model_info(self) -> dict:
@@ -780,10 +795,12 @@ class EmbeddingEngine:
         else:
             params = _shard_params(params, bert_mod.param_specs(cfg), self.mesh)
         self.params = params
-        self._embed_fn = jax.jit(self._embed_impl, static_argnums=(2,))
+        # params as an explicit argument, not a capture (same compile-
+        # cache/lowering rationale as DecoderEngine).
+        self._embed_fn = jax.jit(self._embed_impl, static_argnums=(3,))
 
-    def _embed_impl(self, tokens, mask, pooling: str):
-        return bert_mod.embed(self.params, self.cfg, tokens, mask, pooling)
+    def _embed_impl(self, params, tokens, mask, pooling: str):
+        return bert_mod.embed(params, self.cfg, tokens, mask, pooling)
 
     MAX_CHUNK = 4096
 
@@ -820,7 +837,9 @@ class EmbeddingEngine:
             tokens[i, : len(ids)] = ids
             mask[i, : len(ids)] = 1
         with self.mesh:
-            out = self._embed_fn(jnp.asarray(tokens), jnp.asarray(mask), pooling)
+            out = self._embed_fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(mask), pooling
+            )
         return np.asarray(out)[:b]
 
     def model_info(self) -> dict:

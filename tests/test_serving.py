@@ -113,6 +113,35 @@ class TestGenerationEngine:
         assert info["num_devices"] == 8
         assert info["mesh"] == {"data": 4, "tensor": 2}
 
+    def test_weights_never_lowered_as_constants(self, gen_engine,
+                                                embed_engine):
+        """Weights must ride as jit ARGUMENTS, not closure captures: a
+        captured param tree is embedded into the lowered module as
+        constants (llama3-8b int8 = 8 GB of HLO — found on-chip when
+        the tunnel first came alive: every big-model warmup blew its
+        compile budget) and keys the persistent compile cache on weight
+        values. tiny-llama is 6.4 MB bf16, so a 1 MB warn threshold
+        trips on any regression."""
+        import warnings
+
+        prior = jax.config.jax_captured_constants_warn_bytes
+        jax.config.update("jax_captured_constants_warn_bytes", 1_000_000)
+        try:
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "error", message=".*constants were captured.*"
+                )
+                # Shapes/static-args no earlier test compiled, so each
+                # call really lowers (module-scoped fixtures share jit
+                # caches; a cache hit would make this test vacuous).
+                gen_engine.generate([[5, 6, 7]], max_new_tokens=3)
+                list(gen_engine.generate_stream(
+                    [5] * 40, max_new_tokens=2
+                ))
+                embed_engine.embed([[101, 5, 102]], pooling="cls")
+        finally:
+            jax.config.update("jax_captured_constants_warn_bytes", prior)
+
 
 class TestEmbeddingEngine:
     def test_embed_batch(self, embed_engine):
