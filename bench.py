@@ -50,6 +50,11 @@ _PROXY_PGID = {"pgid": None}
 # phase or teardown, the watchdog prints THIS instead of hanging
 # forever or discarding the finished measurement.
 _STASHED = {"line": None}
+
+
+class _SkipPhase(Exception):
+    """Raised inside a secondary phase's try block to skip it (the
+    except already logs-and-continues; GGRMCP_BENCH_HEADLINE_ONLY)."""
 _PRINTED = {"done": False}
 
 
@@ -461,12 +466,19 @@ async def _run_bench() -> dict:
         if not _claim_output():
             raise RuntimeError("watchdog claimed output before run completed")
 
+        # Knob-tuning runs (e.g. a TICK_STEPS sweep in a live tunnel
+        # window) only need the headline number; the secondary phases
+        # triple the wall clock.
+        headline_only = os.environ.get("GGRMCP_BENCH_HEADLINE_ONLY") == "1"
+
         # Shared-system-prompt phase: every session prepends the same
         # long preamble (the agentic deployment shape). One seeding
         # call pools the prefix, then the concurrent wave reuses its
         # KV; the in-process sidecar exposes the hit counters directly.
         prefix = {}
         try:
+            if headline_only:
+                raise _SkipPhase()
             preamble = (
                 "You are the assistant for the Acme knowledge base. "
                 "Answer briefly, cite sources, refuse speculation. "
@@ -521,6 +533,8 @@ async def _run_bench() -> dict:
                 "prefix_hits": int(batcher.prefix_hits) - hits0,
                 "prefix_misses": int(batcher.prefix_misses) - misses0,
             }
+        except _SkipPhase:
+            pass
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: prefix phase failed: {exc!r}", file=sys.stderr)
 
@@ -533,6 +547,8 @@ async def _run_bench() -> dict:
         # chunked prefill, not the short pool.
         longp = {}
         try:
+            if headline_only:
+                raise _SkipPhase()
             # tokens ≈ chars (byte tokenizer): a genuinely long prompt
             # (>=4096 when the model's context allows) routed to the
             # long tier — past FLASH_MIN_SEQ so a TPU run exercises the
@@ -598,6 +614,8 @@ async def _run_bench() -> dict:
                 ),
                 "long_prompt_target": tgt,
             }
+        except _SkipPhase:
+            pass
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: long-prompt phase failed: {exc!r}", file=sys.stderr)
 
@@ -620,11 +638,12 @@ async def _run_bench() -> dict:
     if not _claim_output():
         raise RuntimeError("watchdog claimed output before run completed")
 
-    try:
-        proxy = await _proxy_bench_isolated()
-    except Exception as exc:  # secondary metric must not sink the run
-        print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
-        proxy = {}
+    proxy = {}
+    if os.environ.get("GGRMCP_BENCH_HEADLINE_ONLY") != "1":
+        try:
+            proxy = await _proxy_bench_isolated()
+        except Exception as exc:  # secondary metric must not sink the run
+            print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
     return {**headline, **hbm, **prefix, **longp, **proxy}
 
 

@@ -154,10 +154,40 @@ stage_8b() {
   have_bench bench_tpu_8b.json
 }
 
+# Tuned follow-ups (round 4): the first window's captures are
+# tunnel-RTT bound — ~220 ms per 8-step tick vs ~3.5 ms/step of
+# arithmetic — so doubling the fused steps per device call and
+# deepening the batch should raise throughput near-linearly until the
+# chip term matters. Headline-only: a tuning point doesn't need the
+# prefix/long/proxy phases.
+stage_1b_t16() {
+  note "stage llama-1b int8 t16/s32: start"
+  GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_KV=int8 GGRMCP_BENCH_TICK_STEPS=16 \
+    GGRMCP_BENCH_SESSIONS=32 GGRMCP_BENCH_CALLS=320 \
+    GGRMCP_BENCH_HEADLINE_ONLY=1 GGRMCP_BENCH_BUDGET_S=900 \
+    timeout 1000 python bench.py \
+    > "$ART/bench_tpu_int8_t16.json" 2> "$ART/bench_tpu_int8_t16.err"
+  note "stage llama-1b int8 t16/s32: rc=$? on_chip=$(have_bench bench_tpu_int8_t16.json && echo yes || echo no)"
+  have_bench bench_tpu_int8_t16.json
+}
+
+stage_8b_t16() {
+  note "stage llama3-8b int8 t16/s16: start"
+  GGRMCP_BENCH_MODEL=llama3-8b GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_KV=int8 \
+    GGRMCP_BENCH_SYNTH=1 GGRMCP_BENCH_TICK_STEPS=16 GGRMCP_BENCH_SESSIONS=16 \
+    GGRMCP_BENCH_CALLS=160 GGRMCP_BENCH_HEADLINE_ONLY=1 \
+    GGRMCP_BENCH_BUDGET_S=1500 timeout 1600 python bench.py \
+    > "$ART/bench_tpu_8b_t16.json" 2> "$ART/bench_tpu_8b_t16.err"
+  note "stage llama3-8b int8 t16/s16: rc=$? on_chip=$(have_bench bench_tpu_8b_t16.json && echo yes || echo no)"
+  have_bench bench_tpu_8b_t16.json
+}
+
 all_done() {
   have_bench bench_tpu_tiny.json && have_bench bench_tpu.json \
     && have_attn && have_bench bench_tpu_int8.json \
-    && have_bench bench_tpu_8b.json
+    && have_bench bench_tpu_8b.json \
+    && have_bench bench_tpu_int8_t16.json \
+    && have_bench bench_tpu_8b_t16.json
 }
 
 run_ladder() {
@@ -166,6 +196,8 @@ run_ladder() {
   have_attn                      || stage_attn || probe || return 1
   have_bench bench_tpu_int8.json || stage_int8 || probe || return 1
   have_bench bench_tpu_8b.json   || stage_8b   || probe || return 1
+  have_bench bench_tpu_int8_t16.json || stage_1b_t16 || probe || return 1
+  have_bench bench_tpu_8b_t16.json   || stage_8b_t16 || probe || return 1
   return 0
 }
 
