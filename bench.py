@@ -325,6 +325,9 @@ async def _run_bench() -> dict:
             kv_cache_max_seq=512,
             kv_tiers=kv_tiers,
             decode_steps_per_tick=tick_steps,
+            # auto = pipelined dispatch on TPU, synchronous on CPU;
+            # "on"/"off" for A/B capture (watcher tuned stages).
+            pipeline_ticks=os.environ.get("GGRMCP_BENCH_PIPELINE", "auto"),
             # Exercised by the shared-system-prompt phase below; the
             # main phase's prompts are shorter than min_seq, so its
             # numbers are unaffected.

@@ -19,6 +19,7 @@ import asyncio
 import dataclasses
 import logging
 import time
+from collections import deque
 from typing import AsyncIterator, Optional
 
 import jax
@@ -103,6 +104,25 @@ class ContinuousBatcher:
 
         b = self.cfg.max_batch_size
         self._steps_per_tick = max(1, self.cfg.decode_steps_per_tick)
+        # Pipelined ticks: tick N+1 is dispatched (device-resident token
+        # feedback) before tick N's tokens are pulled to the host, so
+        # the host round-trip overlaps the next tick's compute. A slot
+        # can then overshoot its budget by up to one EXTRA tick before
+        # the host notices EOS/max_new — the cache reserve doubles.
+        # "auto" enables it only when there is a real accelerator to
+        # overlap with: on CPU the lagged tick is pure extra compute.
+        mode = getattr(self.cfg, "pipeline_ticks", "off")
+        self._pipeline = mode == "on" or (
+            mode == "auto"
+            and engine.mesh.devices.flat[0].platform == "tpu"
+        )
+        self._reserve = (
+            2 * self._steps_per_tick - 1 if self._pipeline
+            else self._steps_per_tick - 1
+        )
+        # In-flight dispatched-not-yet-collected ticks, oldest first:
+        # (tokens [B, steps] device array, per-slot owner snapshot).
+        self._inflight: deque = deque()
         # Ring-buffer serving (engine.ring_capacity, sliding-window
         # models): the cache holds window + prefill_chunk - 1 positions
         # and request length is bounded by the RoPE range, not the
@@ -129,7 +149,13 @@ class ContinuousBatcher:
         self.max_seq = s_max
         self.cache = engine.make_cache(b, s_max)
         # Host-mirrored per-slot state, pushed to device each tick.
+        # cur_tokens additionally keeps a DEVICE-resident twin
+        # (_cur_dev): the tick feeds on the previous tick's last-step
+        # tokens without a host round-trip; admission patches single
+        # entries with eager .at[].set (async-dispatched, no sync). The
+        # host mirror trails by a tick and only seeds rebuilds.
         self.cur_tokens = np.zeros((b,), np.int32)
+        self._cur_dev = None  # lazily jnp.asarray(cur_tokens)
         self.temps = np.zeros((b,), np.float32)
         self.top_ks = np.zeros((b,), np.int32)
         self.top_ps = np.ones((b,), np.float32)
@@ -154,7 +180,7 @@ class ContinuousBatcher:
         # admissible: fit_request caps prompts at s_max minus the tick
         # overshoot reserve, max_new (>= 1), and the next position.
         poolable = (
-            self._pfx_min + 1 <= s_max - (self._steps_per_tick - 1) - 2
+            self._pfx_min + 1 <= s_max - self._reserve - 2
             and not self._ring  # pooled prefixes assume contiguous layout
         )
         if pe > 0 and poolable:
@@ -593,6 +619,8 @@ class ContinuousBatcher:
         slot.max_new = request.max_new
         slot.done = False
         self.cur_tokens[slot_idx] = first_tok
+        if self._cur_dev is not None:
+            self._cur_dev = self._cur_dev.at[slot_idx].set(first_tok)
         self.temps[slot_idx] = request.sampling.temperature
         self.top_ks[slot_idx] = request.sampling.top_k
         self.top_ps[slot_idx] = request.sampling.top_p
@@ -726,11 +754,12 @@ class ContinuousBatcher:
         (non-streaming consumers): one terminal chunk with all tokens —
         same iterator contract, a fraction of the cross-thread events
         (see _Request.unary)."""
-        # Reserve steps_per_tick-1 cache slots: a tick may overshoot a
-        # slot's max_new by up to that many positions before the host
-        # masks the extra tokens.
+        # Reserve cache positions for tick overshoot: a tick may run
+        # past a slot's max_new by up to steps_per_tick-1 positions
+        # before the host masks the extra tokens — one further full
+        # tick under pipelining (emission lags the dispatch by a tick).
         prompt, max_new = fit_request(
-            prompt, max_new, self._fit_limit - (self._steps_per_tick - 1)
+            prompt, max_new, self._fit_limit - self._reserve
         )
         request = _Request(
             prompt=prompt, max_new=max_new, sampling=sampling, seed=seed,
@@ -781,6 +810,20 @@ class ContinuousBatcher:
         while not self._stopping:
             admitted = await self._admit()
             if self._active_count() == 0:
+                if self._inflight:
+                    # The last live requests finished while a pipelined
+                    # tick was already dispatched: drain it (its rows'
+                    # owners are gone, so this emits nothing) before
+                    # sleeping, or a terminal tick would sit in flight
+                    # across an idle period.
+                    try:
+                        await loop.run_in_executor(
+                            None, self._drain_inflight
+                        )
+                    except Exception:
+                        logger.exception("in-flight tick drain failed")
+                        self._reset_after_tick_failure()
+                    continue
                 # Clear BEFORE checking pending: a submit() landing after
                 # the check still leaves its set() visible to wait(),
                 # avoiding the lost-wakeup race.
@@ -791,27 +834,36 @@ class ContinuousBatcher:
                 continue
             # One batched decode tick (device-bound → executor).
             try:
-                await loop.run_in_executor(None, self._tick_sync)
+                await loop.run_in_executor(None, self._tick_step)
             except Exception:
                 # Fail every active request rather than dying silently;
                 # the loop stays alive for future submissions.
                 logger.exception("decode tick failed; failing active slots")
-                for slot in self.slots:
-                    if slot.active and slot.request is not None:
-                        self._loop_ref.call_soon_threadsafe(
-                            slot.request.out.put_nowait, ([], "error")
-                        )
-                    slot.active = False
-                    slot.request = None
-                    slot.done = False
-                # The tick donated the shared cache, so its buffers are
-                # dead after an error — rebuild, or every future
-                # admission scatter would fail and no request could
-                # ever succeed.
-                self.cache = self.engine.make_cache(
-                    len(self.slots), self.max_seq
-                )
+                self._reset_after_tick_failure()
             await asyncio.sleep(0)  # let handlers drain queues
+
+    def _drain_inflight(self) -> None:
+        while self._inflight:
+            self._tick_collect_one()
+
+    def _reset_after_tick_failure(self) -> None:
+        for slot in self.slots:
+            if slot.active and slot.request is not None:
+                self._loop_ref.call_soon_threadsafe(
+                    slot.request.out.put_nowait, ([], "error")
+                )
+            slot.active = False
+            slot.request = None
+            slot.done = False
+        # The tick donated the shared cache, so its buffers are dead
+        # after an error — rebuild, or every future admission scatter
+        # would fail and no request could ever succeed. The in-flight
+        # queue and device token feedback are poisoned with it.
+        self._inflight.clear()
+        self._cur_dev = None
+        self.cache = self.engine.make_cache(
+            len(self.slots), self.max_seq
+        )
 
     async def _admit(self) -> int:
         """Admit pending requests into free slots. Pending requests are
@@ -990,19 +1042,54 @@ class ContinuousBatcher:
         if not single:
             self._pfx_learn_from_burst(slots_idx, batch)
 
-    def _tick_sync(self) -> None:
+    def _tick_step(self) -> None:
+        """One loop turn of decode work: dispatch a tick, then collect
+        down to the pipeline depth. Synchronous mode (pipeline_ticks
+        off) collects the tick it just dispatched — the classic loop;
+        pipelined mode leaves it in flight and collects the PREVIOUS
+        one, so the host pull of tick N overlaps tick N+1's compute."""
+        self._tick_dispatch()
+        depth = 1 if self._pipeline else 0
+        while len(self._inflight) > depth:
+            self._tick_collect_one()
+
+    def _tick_dispatch(self) -> None:
         step0 = self.step_counter
         self.step_counter += self._steps_per_tick
         active = np.array([s.active for s in self.slots], bool)
+        if self._cur_dev is None:
+            self._cur_dev = jnp.asarray(self.cur_tokens)
         toks, self.cache = self._tick(
-            self.engine.params, jnp.asarray(self.cur_tokens), self.cache,
+            self.engine.params, self._cur_dev, self.cache,
             jnp.asarray(self.seeds), jnp.int32(step0 + 1),
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
             jnp.asarray(self.top_ps), jnp.asarray(active),
         )
-        toks = np.asarray(toks)  # [B, steps_per_tick]
-        for i, slot in enumerate(self.slots):
-            if not slot.active:
+        # Device-side feedback for the next tick; no host sync.
+        self._cur_dev = toks[:, -1]
+        try:
+            toks.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass  # transfer will happen at collect time instead
+        # Owner snapshot: emission must credit each row to the request
+        # that owned the slot AT DISPATCH — under pipelining a slot can
+        # finish (tick N's emission) and be re-admitted before tick
+        # N+1's junk row for the old request is collected.
+        owners = [s.request if s.active else None for s in self.slots]
+        self._inflight.append((toks, owners))
+
+    def _tick_collect_one(self) -> None:
+        """Pull the oldest in-flight tick's tokens to the host and emit
+        them. Rows whose owner no longer holds the slot (finished — and
+        possibly re-admitted — since dispatch) are dropped: their
+        tokens are the junk a parked slot keeps sampling."""
+        toks_dev, owners = self._inflight.popleft()
+        toks = np.asarray(toks_dev)  # [B, steps_per_tick]
+        for i, request in enumerate(owners):
+            if request is None:
+                continue
+            slot = self.slots[i]
+            if slot.request is not request:
                 continue
             self.cur_tokens[i] = toks[i, -1]
             self._emit_chunk(i, toks[i])
@@ -1029,6 +1116,16 @@ class ContinuousBatcher:
         if request.cancelled:
             finished_reason = finished_reason or "cancelled"
             ids = []
+        if finished_reason is not None:
+            # Park the slot BEFORE delivering the terminal chunk: the
+            # moment the consumer sees it, the request is observably
+            # complete — a stats scrape racing this executor thread
+            # must not count the slot as still active.
+            slot.active = False
+            slot.request = None
+            # Freeze the row so it stops influencing shared state
+            # (cache row stays, masked by length on reuse).
+            self.temps[slot_idx] = 0.0
         if request.unary:
             request.acc.extend(ids)
             if finished_reason is not None:
@@ -1042,12 +1139,6 @@ class ContinuousBatcher:
             self._loop_ref.call_soon_threadsafe(
                 request.out.put_nowait, (ids, finished_reason)
             )
-        if finished_reason is not None:
-            slot.active = False
-            slot.request = None
-            # Park the slot: freeze its row so it stops influencing
-            # shared state (cache row stays, masked by length on reuse).
-            self.temps[slot_idx] = 0.0
 
     def _emit(self, slot_idx: int, token: int) -> None:
         self._emit_chunk(slot_idx, [token])

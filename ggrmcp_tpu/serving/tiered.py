@@ -58,9 +58,12 @@ class TieredBatcher:
 
     def _route(self, prompt_len: int, max_new: int) -> ContinuousBatcher:
         """Smallest tier whose cache fits the request (incl. the
-        tick-overshoot reserve the batcher subtracts in submit)."""
+        tick-overshoot reserve the batcher subtracts in submit —
+        tier._reserve, which doubles under pipelined ticks; routing on
+        anything smaller silently truncates max_new in a tier whose
+        bigger sibling would have served the request in full)."""
         for tier in self.tiers:
-            need = prompt_len + max_new + tier._steps_per_tick
+            need = prompt_len + max_new + 1 + tier._reserve
             if need <= tier.max_seq:
                 return tier
         return self.tiers[-1]  # clamp policy of the largest pool applies

@@ -41,6 +41,10 @@ export GGRMCP_BENCH_NO_FALLBACK=1  # dead tunnel mid-stage: fail fast, re-probe
 # Single instance: two watchers would double-book the tunnel and
 # truncate each other's in-progress artifacts (> redirections). The
 # lock dies with the process, so a crashed watcher never wedges it.
+# Children (sleeps, python stages) must NOT inherit fd 9: an orphaned
+# `sleep 180` holding the inherited lock fd blocks every future watcher
+# start for 3 minutes after a kill (bitten once). Long-lived sleeps
+# close it explicitly (9>&-); stage subprocesses exit with their run.
 exec 9>"$ART/.watch.lock"
 if ! flock -n 9; then
   echo "tpu_watch: another instance holds $ART/.watch.lock; exiting" >&2
@@ -83,7 +87,7 @@ probe() {
   # stderr is kept: the audit log must distinguish "tunnel down"
   # (timeout, rc=124) from environment breakage (ImportError, PJRT
   # misconfig), or it can't serve as evidence.
-  out=$(timeout 120 python -c "
+  out=$(timeout 120 python 9>&- -c "
 import jax
 d = jax.devices()
 print('PROBE-OK', d[0].platform, d[0].device_kind, len(d), flush=True)
@@ -112,7 +116,7 @@ have_attn()  {
 stage_tiny() {
   note "stage tiny-llama: start"
   GGRMCP_BENCH_MODEL=tiny-llama-8k GGRMCP_BENCH_SESSIONS=8 GGRMCP_BENCH_CALLS=64 \
-    GGRMCP_BENCH_BUDGET_S=600 timeout 660 python bench.py \
+    GGRMCP_BENCH_BUDGET_S=600 timeout 660 python bench.py 9>&- \
     > "$ART/bench_tpu_tiny.json" 2> "$ART/bench_tpu_tiny.err"
   note "stage tiny-llama: rc=$? on_chip=$(have_bench bench_tpu_tiny.json && echo yes || echo no)"
   have_bench bench_tpu_tiny.json
@@ -120,7 +124,7 @@ stage_tiny() {
 
 stage_1b() {
   note "stage llama-1b bf16: start"
-  GGRMCP_BENCH_BUDGET_S=1200 timeout 1300 python bench.py \
+  GGRMCP_BENCH_BUDGET_S=1200 timeout 1300 python bench.py 9>&- \
     > "$ART/bench_tpu.json" 2> "$ART/bench_tpu.err"
   note "stage llama-1b bf16: rc=$? on_chip=$(have_bench bench_tpu.json && echo yes || echo no)"
   have_bench bench_tpu.json
@@ -128,7 +132,7 @@ stage_1b() {
 
 stage_attn() {
   note "stage attention table: start"
-  timeout 900 python scripts/bench_attention.py --iters 10 \
+  timeout 900 python scripts/bench_attention.py 9>&- --iters 10 \
     --seqs 256 512 1024 2048 4096 \
     > "$ART/attn_bench.txt" 2> "$ART/attn_bench.err"
   note "stage attention table: rc=$? on_chip=$(have_attn && echo yes || echo no)"
@@ -138,7 +142,7 @@ stage_attn() {
 stage_int8() {
   note "stage llama-1b int8+int8kv: start"
   GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_KV=int8 GGRMCP_BENCH_BUDGET_S=900 \
-    timeout 1000 python bench.py \
+    timeout 1000 python bench.py 9>&- \
     > "$ART/bench_tpu_int8.json" 2> "$ART/bench_tpu_int8.err"
   note "stage llama-1b int8+int8kv: rc=$? on_chip=$(have_bench bench_tpu_int8.json && echo yes || echo no)"
   have_bench bench_tpu_int8.json
@@ -148,7 +152,7 @@ stage_8b() {
   note "stage llama3-8b int8 synth: start"
   GGRMCP_BENCH_MODEL=llama3-8b GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_KV=int8 \
     GGRMCP_BENCH_SYNTH=1 GGRMCP_BENCH_SESSIONS=8 GGRMCP_BENCH_BUDGET_S=1500 \
-    timeout 1600 python bench.py \
+    timeout 1600 python bench.py 9>&- \
     > "$ART/bench_tpu_8b.json" 2> "$ART/bench_tpu_8b.err"
   note "stage llama3-8b int8 synth: rc=$? on_chip=$(have_bench bench_tpu_8b.json && echo yes || echo no)"
   have_bench bench_tpu_8b.json
@@ -165,7 +169,7 @@ stage_1b_t16() {
   GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_KV=int8 GGRMCP_BENCH_TICK_STEPS=16 \
     GGRMCP_BENCH_SESSIONS=32 GGRMCP_BENCH_CALLS=320 \
     GGRMCP_BENCH_HEADLINE_ONLY=1 GGRMCP_BENCH_BUDGET_S=900 \
-    timeout 1000 python bench.py \
+    timeout 1000 python bench.py 9>&- \
     > "$ART/bench_tpu_int8_t16.json" 2> "$ART/bench_tpu_int8_t16.err"
   note "stage llama-1b int8 t16/s32: rc=$? on_chip=$(have_bench bench_tpu_int8_t16.json && echo yes || echo no)"
   have_bench bench_tpu_int8_t16.json
@@ -176,10 +180,24 @@ stage_8b_t16() {
   GGRMCP_BENCH_MODEL=llama3-8b GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_KV=int8 \
     GGRMCP_BENCH_SYNTH=1 GGRMCP_BENCH_TICK_STEPS=16 GGRMCP_BENCH_SESSIONS=16 \
     GGRMCP_BENCH_CALLS=160 GGRMCP_BENCH_HEADLINE_ONLY=1 \
-    GGRMCP_BENCH_BUDGET_S=1500 timeout 1600 python bench.py \
+    GGRMCP_BENCH_BUDGET_S=1500 timeout 1600 python bench.py 9>&- \
     > "$ART/bench_tpu_8b_t16.json" 2> "$ART/bench_tpu_8b_t16.err"
   note "stage llama3-8b int8 t16/s16: rc=$? on_chip=$(have_bench bench_tpu_8b_t16.json && echo yes || echo no)"
   have_bench bench_tpu_8b_t16.json
+}
+
+# Pipeline A/B: same knobs as the banked base int8 stage but with the
+# pipelined tick dispatch forced OFF — the delta against
+# bench_tpu_int8.json (pipeline auto=on over the tunnel) measures what
+# overlap buys on a remote-RTT link.
+stage_1b_nopipe() {
+  note "stage llama-1b int8 nopipe: start"
+  GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_KV=int8 GGRMCP_BENCH_PIPELINE=off \
+    GGRMCP_BENCH_HEADLINE_ONLY=1 GGRMCP_BENCH_BUDGET_S=600 \
+    timeout 700 python bench.py 9>&- \
+    > "$ART/bench_tpu_int8_nopipe.json" 2> "$ART/bench_tpu_int8_nopipe.err"
+  note "stage llama-1b int8 nopipe: rc=$? on_chip=$(have_bench bench_tpu_int8_nopipe.json && echo yes || echo no)"
+  have_bench bench_tpu_int8_nopipe.json
 }
 
 all_done() {
@@ -187,7 +205,8 @@ all_done() {
     && have_attn && have_bench bench_tpu_int8.json \
     && have_bench bench_tpu_8b.json \
     && have_bench bench_tpu_int8_t16.json \
-    && have_bench bench_tpu_8b_t16.json
+    && have_bench bench_tpu_8b_t16.json \
+    && have_bench bench_tpu_int8_nopipe.json
 }
 
 run_ladder() {
@@ -198,6 +217,7 @@ run_ladder() {
   have_bench bench_tpu_8b.json   || stage_8b   || probe || return 1
   have_bench bench_tpu_int8_t16.json || stage_1b_t16 || probe || return 1
   have_bench bench_tpu_8b_t16.json   || stage_8b_t16 || probe || return 1
+  have_bench bench_tpu_int8_nopipe.json || stage_1b_nopipe || probe || return 1
   return 0
 }
 
@@ -220,7 +240,7 @@ while true; do
   # sequentially in this same loop.
   if pgrep -f "python bench.py" >/dev/null 2>&1; then
     note "probe deferred: a bench run owns the core"
-    sleep "$PROBE_EVERY_S"
+    sleep "$PROBE_EVERY_S" 9>&-
     continue
   fi
   if probe; then
@@ -232,8 +252,8 @@ while true; do
     run_ladder
     # A pass that didn't finish everything always sleeps before the
     # next attempt so a fast-failing stage can't spin the loop.
-    all_done || sleep "$PROBE_EVERY_S"
+    all_done || sleep "$PROBE_EVERY_S" 9>&-
   else
-    sleep "$PROBE_EVERY_S"
+    sleep "$PROBE_EVERY_S" 9>&-
   fi
 done

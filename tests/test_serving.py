@@ -334,6 +334,93 @@ class TestFusedDecodeTicks:
             await batcher.stop()
 
 
+class TestPipelinedTicks:
+    """pipeline_ticks: dispatch tick N+1 before collecting tick N.
+    Token values must equal the synchronous loop's (same programs,
+    same device-side feedback); the owner snapshot must keep re-used
+    slots from crediting a predecessor's junk tokens."""
+
+    async def _run_all(self, engine, pipeline, prompts, max_new, batch=2):
+        from ggrmcp_tpu.serving.batching import ContinuousBatcher
+
+        batcher = ContinuousBatcher(
+            engine,
+            BatchingConfig(
+                max_batch_size=batch, kv_cache_max_seq=256,
+                decode_steps_per_tick=4, pipeline_ticks=pipeline,
+            ),
+        )
+        batcher.start()
+
+        async def one(p, seed):
+            out: list[int] = []
+            reason = None
+            async for ids, reason in batcher.submit(
+                p, max_new, SamplingConfig(temperature=0.0), seed=seed
+            ):
+                out.extend(ids)
+            return out, reason
+
+        try:
+            return await asyncio.gather(
+                *(one(p, i) for i, p in enumerate(prompts))
+            )
+        finally:
+            await batcher.stop()
+
+    async def test_pipelined_matches_synchronous(self, gen_engine):
+        prompts = [[3, 1, 4, 1], [2, 7, 1], [5, 5, 5, 5, 5], [9, 9]]
+        on = await self._run_all(gen_engine, "on", prompts, 8)
+        off = await self._run_all(gen_engine, "off", prompts, 8)
+        # Greedy decode of independent rows: outputs are a function of
+        # the prompt alone, whatever the batching/pipelining timing.
+        assert [o for o, _ in on] == [o for o, _ in off]
+        for _, reason in on:
+            assert reason in ("length", "stop")
+
+    async def test_slot_churn_over_pipeline_lag(self, gen_engine):
+        """12 short requests through 2 slots: every slot is re-admitted
+        several times while a stale tick for its previous owner is in
+        flight — each request still gets exactly its own tokens."""
+        prompts = [[3 + (i % 5), 1, 4] for i in range(12)]
+        churned = await self._run_all(gen_engine, "on", prompts, 3, batch=2)
+        solo = await self._run_all(
+            gen_engine, "on", [prompts[0]], 3, batch=2
+        )
+        for (out, reason), p in zip(churned, prompts):
+            assert reason in ("length", "stop")
+            if reason == "length":
+                assert len(out) == 3
+            if p == prompts[0] and reason == solo[0][1]:
+                assert out == solo[0][0]
+
+    async def test_unary_over_pipeline(self, gen_engine):
+        from ggrmcp_tpu.serving.batching import ContinuousBatcher
+
+        batcher = ContinuousBatcher(
+            gen_engine,
+            BatchingConfig(
+                max_batch_size=2, kv_cache_max_seq=256,
+                decode_steps_per_tick=4, pipeline_ticks="on",
+            ),
+        )
+        batcher.start()
+        try:
+            chunks = [
+                (ids, r) async for ids, r in batcher.submit(
+                    [3, 1, 4], 6, SamplingConfig(temperature=0.0),
+                    unary=True,
+                )
+            ]
+            assert len(chunks) == 1  # one terminal chunk
+            ids, reason = chunks[0]
+            assert reason in ("length", "stop")
+            if reason == "length":
+                assert len(ids) == 6
+        finally:
+            await batcher.stop()
+
+
 class TestChunkedPrefill:
     """Prompts longer than cfg.prefill_chunk are prefilled in fixed
     chunks; greedy output must equal the engine's whole-prompt path."""
@@ -405,14 +492,14 @@ class TestBatcherRecovery:
         )
         batcher.start()
         try:
-            real_tick = batcher._tick_sync
+            real_tick = batcher._tick_step
             calls = {"n": 0}
 
             def flaky_tick():
                 calls["n"] += 1
                 raise RuntimeError("injected device failure")
 
-            batcher._tick_sync = flaky_tick
+            batcher._tick_step = flaky_tick
             chunks = [
                 r async for _, r in batcher.submit(
                     [3, 1, 4], 4, SamplingConfig(temperature=0.0)
@@ -420,7 +507,7 @@ class TestBatcherRecovery:
             ]
             assert chunks[-1] == "error" and calls["n"] >= 1
 
-            batcher._tick_sync = real_tick
+            batcher._tick_step = real_tick
             out: list[int] = []
             reason = None
             async for ids, reason in batcher.submit(
