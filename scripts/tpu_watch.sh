@@ -74,6 +74,7 @@ sync_round() {
     mv "${stale[@]}" "$arch/"
     note "round rolled to $round_id: archived ${#stale[@]} artifact(s) to $arch"
   fi
+  rm -f "$ART/.rebanked_1b"  # a new round may rebank again
   echo "$round_id" > "$ART/.round"
 }
 
@@ -200,13 +201,36 @@ stage_1b_nopipe() {
   have_bench bench_tpu_int8_nopipe.json
 }
 
+# Rebank: the first window's full-phase artifacts were captured before
+# pipelined ticks landed (synchronous loop, tick=8). A later window
+# re-runs the flagship stage with the improved serving loop and
+# ATOMICALLY replaces the banked artifact only on an on-chip-valid
+# result — a dying tunnel must never truncate a banked capture (the
+# base stages' > redirect would). The marker file keeps one attempt
+# per window from looping.
+stage_rebank_1b() {
+  note "stage rebank llama-1b bf16 (pipelined): start"
+  GGRMCP_BENCH_BUDGET_S=1200 timeout 1300 python bench.py 9>&- \
+    > "$ART/bench_tpu_v2.json" 2> "$ART/bench_tpu_v2.err"
+  local rc=$?
+  if have_bench bench_tpu_v2.json; then
+    mv "$ART/bench_tpu_v2.json" "$ART/bench_tpu.json"
+    note "stage rebank llama-1b: rc=$rc REBANKED (pipelined capture)"
+    touch "$ART/.rebanked_1b"
+    return 0
+  fi
+  note "stage rebank llama-1b: rc=$rc on_chip=no (banked artifact kept)"
+  return 1
+}
+
 all_done() {
   have_bench bench_tpu_tiny.json && have_bench bench_tpu.json \
     && have_attn && have_bench bench_tpu_int8.json \
     && have_bench bench_tpu_8b.json \
     && have_bench bench_tpu_int8_t16.json \
     && have_bench bench_tpu_8b_t16.json \
-    && have_bench bench_tpu_int8_nopipe.json
+    && have_bench bench_tpu_int8_nopipe.json \
+    && [ -f "$ART/.rebanked_1b" ]
 }
 
 run_ladder() {
@@ -218,6 +242,7 @@ run_ladder() {
   have_bench bench_tpu_int8_t16.json || stage_1b_t16 || probe || return 1
   have_bench bench_tpu_8b_t16.json   || stage_8b_t16 || probe || return 1
   have_bench bench_tpu_int8_nopipe.json || stage_1b_nopipe || probe || return 1
+  [ -f "$ART/.rebanked_1b" ] || stage_rebank_1b || probe || return 1
   return 0
 }
 
