@@ -408,6 +408,19 @@ class ServingConfig:
     sp_prefill_min_seq: int = 1024
     # Orbax checkpoint for the draft's params (empty → random init).
     speculative_draft_checkpoint: str = ""
+    # Multi-LoRA serving (ops/lora.py): named adapters served from the
+    # SAME continuous batch via per-row low-rank deltas on the fused
+    # qkv projection. Dense Llama, single-stage meshes only (the
+    # engine validates); empty adapter list = off.
+    lora: "LoraConfig" = field(default_factory=lambda: LoraConfig())
+
+
+@dataclass
+class LoraConfig:
+    # Adapter names; request field `adapter` selects one. Served ids
+    # are 1..N in list order (0 = the base model). Empty = LoRA off.
+    adapters: list = field(default_factory=list)
+    rank: int = 8  # low-rank dimension r (factors stored pre-scaled)
 
 
 # ---------------------------------------------------------------------------

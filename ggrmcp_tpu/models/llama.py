@@ -235,6 +235,7 @@ def attention_block(
     flash_mesh: Any = None,
     attn_impl: Optional[Any] = None,
     ring: bool = False,
+    lora_idx: Optional[jnp.ndarray] = None,  # [B] adapter ids
 ):
     """Pre-norm GQA attention with residual; shared by the dense and MoE
     decoder families. Returns (x + attn, (cache_k, cache_v) or None).
@@ -262,6 +263,15 @@ def attention_block(
 
     normed = common.rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
     qkv = qmatmul(normed, layer_params["wqkv"])  # [B, S, (H+2KVH)*Dh]
+    if lora_idx is not None and "lora_qkv_a" in layer_params:
+        # Multi-LoRA: per-row adapter delta on the fused qkv projection
+        # (ops/lora.py — row 0 is the base no-op adapter).
+        from ggrmcp_tpu.ops import lora as lora_mod
+
+        qkv = qkv + lora_mod.lora_delta(
+            normed, layer_params["lora_qkv_a"],
+            layer_params["lora_qkv_b"], lora_idx,
+        )
     q, kv = jnp.split(qkv, [h * hd], axis=-1)
     k, v = jnp.split(kv, 2, axis=-1)
     q = q.reshape(b, s, h, hd)
@@ -391,11 +401,12 @@ def _layer(
     flash_mesh: Any = None,
     attn_impl: Optional[Any] = None,
     ring: bool = False,
+    lora_idx: Optional[jnp.ndarray] = None,
 ):
     x, new_cache = attention_block(
         x, layer_params, cfg, positions, cache_k, cache_v, cache_len,
         use_flash=use_flash, flash_mesh=flash_mesh, attn_impl=attn_impl,
-        ring=ring,
+        ring=ring, lora_idx=lora_idx,
     )
 
     # SwiGLU MLP
@@ -416,6 +427,7 @@ def forward(
     flash_mesh: Any = None,
     attn_impl: Optional[Any] = None,
     ring: bool = False,
+    lora_idx: Optional[jnp.ndarray] = None,
 ) -> tuple[jnp.ndarray, Optional[KVCache]]:
     """Run the decoder. Without a cache: plain causal forward (training/
     scoring). With a cache: serving — tokens are appended at each
@@ -425,6 +437,8 @@ def forward(
     `use_flash`: None = auto (ops.attention decides per shape/platform);
     False forces the XLA path (multi-device meshes — see ops/attention).
     `attn_impl`: sequence-parallel fresh-prefill hook (attention_block).
+    `lora_idx`: [B] per-row adapter ids when `params["layers"]` carries
+    stacked LoRA factors (ops/lora.py); None or absent factors = base.
 
     Returns (logits [B, S, V], updated cache or None).
     """
@@ -444,7 +458,7 @@ def forward(
             x, _ = _layer(
                 x, layer_params, cfg, positions, None, None, None,
                 use_flash=use_flash, flash_mesh=flash_mesh,
-                attn_impl=attn_impl,
+                attn_impl=attn_impl, lora_idx=lora_idx,
             )
             return x, None
 
@@ -457,7 +471,7 @@ def forward(
             x, (ck, cv) = _layer(
                 x, layer_params, cfg, positions, ck, cv, cache.length,
                 use_flash=use_flash, flash_mesh=flash_mesh,
-                attn_impl=attn_impl, ring=ring,
+                attn_impl=attn_impl, ring=ring, lora_idx=lora_idx,
             )
             return x, (ck, cv)
 

@@ -1,0 +1,61 @@
+"""Multi-LoRA serving: per-request low-rank adapters batched on the MXU.
+
+The reference has no model plane at all (SURVEY.md §2.4); this is the
+TPU-native answer to the multi-tenant serving question its gateway
+raises — one base model, many cheap per-request specializations,
+served from the SAME continuous batch:
+
+- Adapter weights are stacked `[L, N+1, ...]` and live INSIDE
+  `params["layers"]` (keys `lora_qkv_a` / `lora_qkv_b`), so the layer
+  scan slices them exactly like every other stacked weight — no new
+  plumbing for weight movement, sharding, or pipeline staging.
+- Row 0 is the BASE "adapter": both factors zero, so requests without
+  an adapter ride the same program with a zero delta. `b` initializes
+  to zero for every row (classic LoRA init) — an adapter is a no-op
+  until its trained factors are loaded (`set_lora_weights`).
+- Per-row application is two batched einsums over gathered factors:
+  `[B,S,D] @ [B,D,r] @ [B,r,O]`. N is small and r tiny, so the gather
+  is cheap and the einsums lower to batched matmuls the MXU tiles
+  natively; one mixed batch serves any mix of adapters in one tick —
+  no bucketing by adapter, no batch splitting.
+
+Factors are stored PRE-SCALED (alpha/r already folded into `b`): the
+serving path has no per-adapter scalar state to thread.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_lora_layers(key, cfg, num_adapters: int, rank: int) -> dict:
+    """Stacked adapter factors for the fused qkv projection.
+
+    Returns {"lora_qkv_a": [L, N+1, D, r], "lora_qkv_b": [L, N+1, r,
+    (H+2KVH)*Dh]} in the model dtype. Row 0 is the base no-op row; rows
+    1..N belong to the configured adapters. `a` gets the usual small
+    normal init, `b` is zero — every adapter starts as an exact no-op.
+    """
+    l, d = cfg.num_layers, cfg.hidden_dim
+    qkv_out = (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
+    dtype = cfg.jnp_dtype
+    a = 0.02 * jax.random.normal(
+        key, (l, num_adapters + 1, d, rank), dtype
+    )
+    a = a.at[:, 0].set(0.0)  # the base row stays an exact no-op
+    b = jnp.zeros((l, num_adapters + 1, rank, qkv_out), dtype)
+    return {"lora_qkv_a": a, "lora_qkv_b": b}
+
+
+def lora_delta(
+    x: jnp.ndarray,  # [B, S, D] (normed activations)
+    a: jnp.ndarray,  # [N+1, D, r] — one layer's slice
+    b: jnp.ndarray,  # [N+1, r, O]
+    idx: jnp.ndarray,  # [B] int32 adapter ids (0 = base/no-op)
+) -> jnp.ndarray:  # [B, S, O]
+    """Per-row adapter delta: x @ A[idx] @ B[idx], batched."""
+    a_sel = jnp.take(a, idx, axis=0)  # [B, D, r]
+    b_sel = jnp.take(b, idx, axis=0)  # [B, r, O]
+    mid = jnp.einsum("bsd,bdr->bsr", x, a_sel)
+    return jnp.einsum("bsr,bro->bso", mid, b_sel)

@@ -78,6 +78,8 @@ class _Request:
     # until the terminal emit) and post once on finish.
     unary: bool = False
     acc: list[int] = dataclasses.field(default_factory=list)
+    # LoRA adapter row id (0 = base model; ops/lora.py).
+    adapter: int = 0
 
 
 class ContinuousBatcher:
@@ -160,6 +162,7 @@ class ContinuousBatcher:
         self.top_ks = np.zeros((b,), np.int32)
         self.top_ps = np.ones((b,), np.float32)
         self.seeds = np.zeros((b,), np.uint32)
+        self.adapter_ids = np.zeros((b,), np.int32)  # per-slot LoRA row
         self.step_counter = 0
 
         # Model family (dense llama or sparse MoE) — same forward
@@ -235,7 +238,9 @@ class ContinuousBatcher:
 
     # -- jitted bodies ------------------------------------------------------
 
-    def _prefill_sample(self, params, tokens, true_len, seeds, temps, ks, ps):
+    def _prefill_sample(
+        self, params, tokens, true_len, seeds, temps, ks, ps, adapters
+    ):
         """Shared admission core: prefill the right-padded prompts
         [R, S] against a fresh mini cache, sample each row's first
         token. Returns (first [R], mini cache)."""
@@ -245,7 +250,7 @@ class ContinuousBatcher:
         # and the sequence-parallel long-chunk path).
         valid = jnp.arange(s)[None, :] < true_len[:, None]
         logits, mini = self.engine.prefill_forward(
-            params, tokens, mini, valid=valid
+            params, tokens, mini, valid=valid, lora_idx=adapters
         )
         first = self._first_token_impl(
             logits, jnp.maximum(true_len - 1, 0), seeds, temps, ks, ps
@@ -253,16 +258,18 @@ class ContinuousBatcher:
         return first, mini
 
     def _admit_single_impl(
-        self, params, tokens, true_len, cache, slot, seeds, temps, ks, ps
+        self, params, tokens, true_len, cache, slot, seeds, temps, ks, ps,
+        adapters,
     ):
         """Admit ONE request (row shapes [1, S]) into slot `slot`."""
         first, mini = self._prefill_sample(
-            params, tokens, true_len, seeds, temps, ks, ps
+            params, tokens, true_len, seeds, temps, ks, ps, adapters
         )
         return first, _merge_row(cache, mini, slot, true_len[0])
 
     def _admit_full_impl(
-        self, params, tokens, true_len, cache, valid, seeds, temps, ks, ps
+        self, params, tokens, true_len, cache, valid, seeds, temps, ks, ps,
+        adapters,
     ):
         """Admit a burst in one call: `tokens` is a full [B, S] batch
         with admitted prompts placed at their slots' rows and
@@ -270,7 +277,7 @@ class ContinuousBatcher:
         row-select, not a scatter, so no duplicate-index hazards)."""
         s = tokens.shape[1]
         first, mini = self._prefill_sample(
-            params, tokens, true_len, seeds, temps, ks, ps
+            params, tokens, true_len, seeds, temps, ks, ps, adapters
         )
         sel = valid[None, :, None, None, None]
 
@@ -285,7 +292,8 @@ class ContinuousBatcher:
         return first, llama_mod.KVCache(k=k, v=v, length=lengths)
 
     def _tick_impl(
-        self, params, tokens, cache, seeds, step, temps, ks, ps, active
+        self, params, tokens, cache, seeds, step, temps, ks, ps, active,
+        adapters,
     ):
         """One device call = `decode_steps_per_tick` fused decode steps
         (lax.scan). Fewer host round-trips per token: tokens sampled
@@ -299,6 +307,7 @@ class ContinuousBatcher:
                 params, cur[:, None], cache,
                 valid=active[:, None] if self._is_moe else None,
                 ring=self._ring,
+                lora_idx=adapters,
             )
             nxt = sample_dynamic(logits[:, -1], seeds, step + i, temps, ks, ps)
             return (nxt, cache), nxt
@@ -308,7 +317,7 @@ class ContinuousBatcher:
         )
         return toks.T, cache  # [B, steps_per_tick]
 
-    def _chunk_step_impl(self, params, tokens, mini, true_len):
+    def _chunk_step_impl(self, params, tokens, mini, true_len, adapter):
         """One [1, C] prefill chunk appended to the row's mini cache at
         its current length. Returns (last-position logits [1, V], mini)."""
         if self._is_moe:
@@ -318,7 +327,8 @@ class ContinuousBatcher:
             valid = None
         # Cache-extending step (not a fresh prefill) → decode_forward.
         logits, mini = self.engine.decode_forward(
-            params, tokens, mini, valid=valid, ring=self._ring
+            params, tokens, mini, valid=valid, ring=self._ring,
+            lora_idx=adapter,
         )
         return logits, mini
 
@@ -508,6 +518,14 @@ class ContinuousBatcher:
         one extra device call, only when at least two rows share it."""
         if self._pfx_pool is None or len(batch) < 2:
             return
+        # Base-model rows only: a cache slice computed under an adapter
+        # must never seed the shared pool (_prefill_into_slots).
+        slots_idx = [
+            s for s, r in zip(slots_idx, batch) if r.adapter == 0
+        ]
+        batch = [r for r in batch if r.adapter == 0]
+        if len(batch) < 2:
+            return
         prompts = [
             np.asarray(r.prompt[: self._pfx_max + 1], np.int32)
             for r in batch
@@ -559,6 +577,7 @@ class ContinuousBatcher:
         prompt = request.prompt
         n = len(prompt)
         c = min(self.cfg.prefill_chunk, self.max_seq)
+        adapter1 = jnp.asarray([request.adapter], jnp.int32)
         mini = self._make_mini(1, self.max_seq)
         start = 0
         if pfx is not None:
@@ -579,12 +598,17 @@ class ContinuousBatcher:
             piece = prompt[off : off + width]
             chunk[0, : len(piece)] = piece
             logits, mini = self._chunk_step(
-                self.engine.params, jnp.asarray(chunk), mini, true_len
+                self.engine.params, jnp.asarray(chunk), mini, true_len,
+                adapter1,
             )
         # Pool the prefix on first sighting — also when a SHORTER
         # pooled prefix hit (the mini row holds the full prompt's KV
         # either way, so the longer entry upgrades future matches).
-        key = self._pfx_storable(prompt)
+        # BASE rows only: adapter'd K/V must never enter the shared
+        # pool (_prefill_into_slots has the full rationale).
+        key = (
+            self._pfx_storable(prompt) if request.adapter == 0 else None
+        )
         if key is not None and (pfx is None or pfx[1] < len(key)):
             self._pfx_insert(mini, key)
         mini = mini._replace(length=jnp.asarray([n], jnp.int32))
@@ -625,6 +649,7 @@ class ContinuousBatcher:
         self.top_ks[slot_idx] = request.sampling.top_k
         self.top_ps[slot_idx] = request.sampling.top_p
         self.seeds[slot_idx] = request.seed & 0xFFFFFFFF
+        self.adapter_ids[slot_idx] = request.adapter
         self._emit(slot_idx, first_tok)
 
     # -- public API ---------------------------------------------------------
@@ -652,6 +677,7 @@ class ContinuousBatcher:
             self.engine.params, jnp.asarray(zeros1), jnp.asarray(zlen1),
             self.cache, jnp.int32(0), jnp.asarray(zseed1),
             jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
+            jnp.asarray(zi1),
         )
         _, self.cache = self._admit_full(
             self.engine.params, jnp.asarray(np.zeros((b, s), np.int32)),
@@ -661,6 +687,7 @@ class ContinuousBatcher:
             jnp.asarray(np.zeros((b,), np.float32)),
             jnp.asarray(np.zeros((b,), np.int32)),
             jnp.asarray(np.ones((b,), np.float32)),
+            jnp.asarray(np.zeros((b,), np.int32)),
         )
         _, self.cache = self._tick(
             self.engine.params, jnp.asarray(self.cur_tokens), self.cache,
@@ -668,6 +695,7 @@ class ContinuousBatcher:
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
             jnp.asarray(self.top_ps),
             jnp.asarray(np.zeros((b,), bool)),
+            jnp.asarray(np.zeros((b,), np.int32)),
         )
         # Chunked-prefill programs (statically shaped: [1, C] chunk into
         # a [1, S_max] mini cache) — the first long-prompt request must
@@ -683,7 +711,7 @@ class ContinuousBatcher:
             mini = self._make_mini(1, self.max_seq)
             logits, mini = self._chunk_step(
                 self.engine.params, jnp.asarray(np.zeros((1, c), np.int32)),
-                mini, jnp.asarray(zlen1),
+                mini, jnp.asarray(zlen1), jnp.asarray(zi1),
             )
             self.cache = self._insert_row(
                 self.cache, mini, jnp.int32(0), jnp.int32(0)
@@ -719,7 +747,7 @@ class ContinuousBatcher:
                         _, mini = self._chunk_step(
                             self.engine.params,
                             jnp.asarray(np.zeros((1, width), np.int32)),
-                            mini, jnp.asarray(zlen1),
+                            mini, jnp.asarray(zlen1), jnp.asarray(zi1),
                         )
                     width *= 2
         jax.block_until_ready(self.cache.k)
@@ -748,12 +776,22 @@ class ContinuousBatcher:
         sampling: SamplingConfig,
         seed: int = 0,
         unary: bool = False,
+        adapter: int = 0,
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         """Enqueue a request; yields (token_ids_chunk, finish_reason)
         pairs; finish_reason is set on the final chunk. `unary=True`
         (non-streaming consumers): one terminal chunk with all tokens —
         same iterator contract, a fraction of the cross-thread events
-        (see _Request.unary)."""
+        (see _Request.unary). `adapter`: LoRA adapter row id (0 = base;
+        resolve names via engine.resolve_adapter)."""
+        # Range-check the adapter row here (names resolve upstream):
+        # jnp.take clips out-of-range gathers, which would silently
+        # serve the WRONG adapter's factors.
+        n_adapters = len(getattr(self.engine, "lora_names", {}))
+        if not 0 <= adapter <= n_adapters:
+            raise ValueError(
+                f"adapter id {adapter} out of range (0..{n_adapters})"
+            )
         # Reserve cache positions for tick overshoot: a tick may run
         # past a slot's max_new by up to steps_per_tick-1 positions
         # before the host masks the extra tokens — one further full
@@ -763,7 +801,7 @@ class ContinuousBatcher:
         )
         request = _Request(
             prompt=prompt, max_new=max_new, sampling=sampling, seed=seed,
-            unary=unary,
+            unary=unary, adapter=adapter,
         )
         await self.pending.put(request)
         self._wake.set()
@@ -861,6 +899,7 @@ class ContinuousBatcher:
         # queue and device token feedback are poisoned with it.
         self._inflight.clear()
         self._cur_dev = None
+        self.adapter_ids[:] = 0
         self.cache = self.engine.make_cache(
             len(self.slots), self.max_seq
         )
@@ -955,8 +994,14 @@ class ContinuousBatcher:
         fused_batch: list[_Request] = []
         trickle = len(batch) == 1
         for sl, req in zip(slots_idx, batch):
-            pfx = self._pfx_lookup(req.prompt)
-            if pfx is None and self._pfx_pool is not None:
+            # The prefix pool holds BASE-model KV only: a pooled prefix
+            # computed under one adapter would silently seed a
+            # different adapter's (or the base model's) request with
+            # contaminated K/V. Adapter'd requests neither consult nor
+            # feed the pool (and don't count as misses — they never
+            # look).
+            pfx = self._pfx_lookup(req.prompt) if req.adapter == 0 else None
+            if pfx is None and self._pfx_pool is not None and req.adapter == 0:
                 # Every pool-enabled lookup miss counts — fused-path
                 # admissions included — or the exported hit/miss ratio
                 # overstates the pool's effectiveness.
@@ -971,7 +1016,8 @@ class ContinuousBatcher:
                 # of N serial chunked ones; shared prefixes in a burst
                 # are learned AFTER the fused call from one admitted
                 # row's cache slice (_pfx_learn_from_burst).
-                trickle and self._pfx_storable(req.prompt) is not None
+                trickle and req.adapter == 0
+                and self._pfx_storable(req.prompt) is not None
             ):
                 self._prefill_chunked(sl, req)
             else:
@@ -1008,6 +1054,7 @@ class ContinuousBatcher:
         ks = np.zeros((rows,), np.int32)
         ps = np.ones((rows,), np.float32)
         valid = np.zeros((rows,), bool)
+        adapters = np.zeros((rows,), np.int32)
         for j, req in enumerate(batch):
             row = row_of(j)
             tokens[row, : len(req.prompt)] = req.prompt
@@ -1017,6 +1064,7 @@ class ContinuousBatcher:
             ks[row] = req.sampling.top_k
             ps[row] = req.sampling.top_p
             valid[row] = True
+            adapters[row] = req.adapter
         self._cache_at_risk = True
         if single:
             first, self.cache = self._admit_single(
@@ -1024,13 +1072,14 @@ class ContinuousBatcher:
                 jnp.asarray(true_len), self.cache,
                 jnp.int32(slots_idx[0]), jnp.asarray(seeds),
                 jnp.asarray(temps), jnp.asarray(ks), jnp.asarray(ps),
+                jnp.asarray(adapters),
             )
         else:
             first, self.cache = self._admit_full(
                 self.engine.params, jnp.asarray(tokens),
                 jnp.asarray(true_len), self.cache, jnp.asarray(valid),
                 jnp.asarray(seeds), jnp.asarray(temps), jnp.asarray(ks),
-                jnp.asarray(ps),
+                jnp.asarray(ps), jnp.asarray(adapters),
             )
         # Materialize BEFORE clearing the at-risk flag: under async
         # dispatch a device failure in the donating call surfaces here,
@@ -1064,6 +1113,7 @@ class ContinuousBatcher:
             jnp.asarray(self.seeds), jnp.int32(step0 + 1),
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
             jnp.asarray(self.top_ps), jnp.asarray(active),
+            jnp.asarray(self.adapter_ids),
         )
         # Device-side feedback for the next tick; no host sync.
         self._cur_dev = toks[:, -1]
@@ -1126,6 +1176,7 @@ class ContinuousBatcher:
             # Freeze the row so it stops influencing shared state
             # (cache row stays, masked by length on reuse).
             self.temps[slot_idx] = 0.0
+            self.adapter_ids[slot_idx] = 0
         if request.unary:
             request.acc.extend(ids)
             if finished_reason is not None:

@@ -28,7 +28,7 @@ from typing import Iterator, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ggrmcp_tpu.core.config import ServingConfig
 from ggrmcp_tpu.models import bert as bert_mod
@@ -202,6 +202,7 @@ class GenerationEngine:
                 params = _shard_params(params, param_specs, self.mesh)
             if self.serving.quantize:
                 params = self._quantize_params(params)
+        params = self._init_lora(params, seed)
         self.params = params
         # Weights ride as explicit jit ARGUMENTS, never closure
         # captures: a closed-over param tree is embedded into the
@@ -223,6 +224,97 @@ class GenerationEngine:
             self._generate_impl, static_argnums=(3, 4)
         )
         self._init_speculative(seed)
+
+    def _init_lora(self, params, seed: int):
+        """Multi-LoRA serving (ops/lora.py): stack per-adapter factors
+        into params["layers"] so the layer scan slices them with every
+        other stacked weight. Runs AFTER quantization — adapter factors
+        stay in the model dtype (they are tiny; int8 would buy nothing
+        and cost accuracy). Row 0 is the base no-op adapter."""
+        self.lora_names: dict[str, int] = {}
+        adapters = list(self.serving.lora.adapters)
+        self.lora_enabled = bool(adapters)
+        if not self.lora_enabled:
+            return params
+        if self.fam is not llama_mod:
+            raise ValueError("lora serving supports dense Llama only")
+        if self.pp_serving:
+            raise ValueError(
+                "lora does not compose with pipeline-parallel serving "
+                "yet (the staged layer loop would need per-stage idx "
+                "threading)"
+            )
+        if self.serving.speculative_draft:
+            raise ValueError(
+                "lora does not compose with speculative decoding (the "
+                "draft/verify loop runs the base model; an adapter'd "
+                "request would silently lose its adapter)"
+            )
+        if self.serving.lora.rank < 1:
+            raise ValueError("lora.rank must be >= 1")
+        if len(set(adapters)) != len(adapters) or "" in adapters:
+            raise ValueError("lora.adapters must be unique, non-empty names")
+        from ggrmcp_tpu.ops import lora as lora_mod
+
+        factors = lora_mod.init_lora_layers(
+            jax.random.PRNGKey(seed + 7), self.cfg, len(adapters),
+            self.serving.lora.rank,
+        )
+        with self.mesh:
+            factors = {
+                k: jax.device_put(
+                    v, NamedSharding(self.mesh, P())
+                ) for k, v in factors.items()
+            }
+        params["layers"] = {**params["layers"], **factors}
+        self.lora_names = {name: i + 1 for i, name in enumerate(adapters)}
+        logger.info(
+            "lora serving: %d adapter(s) %s, rank %d (%.1f MB of factors)",
+            len(adapters), adapters, self.serving.lora.rank,
+            sum(v.nbytes for v in factors.values()) / 1e6,
+        )
+        return params
+
+    def resolve_adapter(self, name: str) -> int:
+        """Adapter name → served row id (0 = base; raises on unknown)."""
+        if not name:
+            return 0
+        try:
+            return self.lora_names[name]
+        except KeyError:
+            raise ValueError(
+                f"unknown adapter {name!r}; configured: "
+                f"{sorted(self.lora_names)}"
+            ) from None
+
+    def set_lora_weights(self, name: str, a, b) -> None:
+        """Install trained factors for a configured adapter: a
+        [L, D, r], b [L, r, (H+2KVH)*Dh] (pre-scaled by alpha/r).
+        Row 0 (base) cannot be written."""
+        idx = self.resolve_adapter(name)
+        if idx == 0:
+            raise ValueError("cannot overwrite the base adapter row")
+        layers = dict(self.params["layers"])
+        dtype = self.cfg.jnp_dtype
+        a = jnp.asarray(a, dtype)
+        b = jnp.asarray(b, dtype)
+        # Explicit shape checks: .at[].set broadcasts, so e.g. a single
+        # layer's [D, r] would silently install identical factors in
+        # every layer instead of erroring.
+        want_a = layers["lora_qkv_a"].shape[0:1] + layers[
+            "lora_qkv_a"
+        ].shape[2:]
+        want_b = layers["lora_qkv_b"].shape[0:1] + layers[
+            "lora_qkv_b"
+        ].shape[2:]
+        if a.shape != want_a or b.shape != want_b:
+            raise ValueError(
+                f"factor shapes {a.shape}/{b.shape} != expected "
+                f"{want_a}/{want_b}"
+            )
+        layers["lora_qkv_a"] = layers["lora_qkv_a"].at[:, idx].set(a)
+        layers["lora_qkv_b"] = layers["lora_qkv_b"].at[:, idx].set(b)
+        self.params = {**self.params, "layers": layers}
 
     def _init_sp_prefill(self) -> None:
         """Sequence-parallel prefill (SURVEY §5.7): when the mesh has a
@@ -271,7 +363,8 @@ class GenerationEngine:
 
         self._sp_attn = sp_attn
 
-    def prefill_forward(self, params, tokens, cache, valid=None):
+    def prefill_forward(self, params, tokens, cache, valid=None,
+                        lora_idx=None):
         """fam.forward for FRESH prefill (cache written from offset 0 —
         the attn_impl contract, models/llama.py::attention_block).
         Dispatches to the sequence-parallel path when configured and
@@ -290,9 +383,12 @@ class GenerationEngine:
         )
         if sp:
             return llama_mod.forward(
-                params, self.cfg, tokens, cache, attn_impl=self._sp_attn
+                params, self.cfg, tokens, cache, attn_impl=self._sp_attn,
+                lora_idx=lora_idx,
             )
-        return self.decode_forward(params, tokens, cache, valid=valid)
+        return self.decode_forward(
+            params, tokens, cache, valid=valid, lora_idx=lora_idx
+        )
 
     def _init_pp_serving(self) -> None:
         """Serving under pipeline parallelism: when the mesh has a
@@ -322,12 +418,16 @@ class GenerationEngine:
             self.sp_prefill = ""
             self._sp_attn = None
 
-    def decode_forward(self, params, tokens, cache, valid=None, ring=False):
+    def decode_forward(
+        self, params, tokens, cache, valid=None, ring=False, lora_idx=None
+    ):
         """fam.forward for decode/extension steps (cache already has
         history). Dispatches to the staged path under PP. `ring` is
         per-call because it describes the CACHE's layout (the batcher's
         ring-capacity caches), not the engine: the engine's own
-        contiguous request-sized caches keep ring=False."""
+        contiguous request-sized caches keep ring=False. `lora_idx`:
+        [B] per-row adapter ids (dense Llama, non-PP — the engine
+        rejects LoRA configs elsewhere)."""
         if self.pp_serving:
             return self._pp.pipeline_forward_cached(
                 params, self.cfg, tokens, cache, self.mesh, ring=ring
@@ -340,7 +440,7 @@ class GenerationEngine:
             )
         return self.fam.forward(
             params, self.cfg, tokens, cache, use_flash=self.use_flash,
-            flash_mesh=self.flash_mesh, ring=ring,
+            flash_mesh=self.flash_mesh, ring=ring, lora_idx=lora_idx,
         )
 
     def _init_speculative(self, seed: int) -> None:
@@ -524,16 +624,18 @@ class GenerationEngine:
 
     # -- jitted bodies ------------------------------------------------------
 
-    def _prefill_impl(self, params, tokens, true_len, cache):
+    def _prefill_impl(self, params, tokens, true_len, cache, lora_idx):
         """tokens [B,S] right-padded; true_len [B]. Returns
         (last_logits [B,V], cache with length=true_len). Fresh-prefill
         only (cache length 0) — dispatches through prefill_forward so
-        long chunks can run sequence-parallel."""
+        long chunks can run sequence-parallel. lora_idx [B]: per-row
+        adapter ids (all-zeros = base model; pruned by XLA when the
+        param tree carries no adapter factors)."""
         # Padding must not compete for expert capacity on MoE (routing
         # is batch-global); dense forwards are pad-invariant already.
         valid = jnp.arange(tokens.shape[1])[None, :] < true_len[:, None]
         logits, cache = self.prefill_forward(
-            params, tokens, cache, valid=valid
+            params, tokens, cache, valid=valid, lora_idx=lora_idx
         )
         idx = jnp.maximum(true_len - 1, 0)
         last = jnp.take_along_axis(
@@ -543,24 +645,29 @@ class GenerationEngine:
         return last, cache
 
     def _decode_impl(
-        self, params, tokens, cache, rng, step, sampling: SamplingConfig
+        self, params, tokens, cache, rng, step, sampling: SamplingConfig,
+        lora_idx,
     ):
         """tokens [B,1] → (next [B], cache)."""
-        logits, cache = self.decode_forward(params, tokens, cache)
+        logits, cache = self.decode_forward(
+            params, tokens, cache, lora_idx=lora_idx
+        )
         key = jax.random.fold_in(rng, step)
         next_tok = sample(logits[:, -1], key, sampling)
         return next_tok, cache
 
     def _generate_impl(
         self, params, tokens, true_len, max_new: int,
-        sampling: SamplingConfig, rng, eos_id,
+        sampling: SamplingConfig, rng, eos_id, lora_idx,
     ):
         """Fused prefill + scan-decode. Returns (out_tokens [B, max_new],
         out_len [B])."""
         b = tokens.shape[0]
         max_cache = tokens.shape[1] + max_new
         cache = llama_mod.KVCache.create(self.cfg, b, max_cache, self.kv_dtype)
-        last_logits, cache = self._prefill_impl(params, tokens, true_len, cache)
+        last_logits, cache = self._prefill_impl(
+            params, tokens, true_len, cache, lora_idx
+        )
         key0 = jax.random.fold_in(rng, 0)
         first = sample(last_logits, key0, sampling)  # [B]
         done0 = first == eos_id
@@ -568,7 +675,7 @@ class GenerationEngine:
         def step(carry, i):
             cur, cache, done = carry
             logits, cache = self.decode_forward(
-                params, cur[:, None], cache
+                params, cur[:, None], cache, lora_idx=lora_idx
             )
             key = jax.random.fold_in(rng, i + 1)
             nxt = sample(logits[:, -1], key, sampling)
@@ -669,17 +776,37 @@ class GenerationEngine:
         sampling: SamplingConfig = SamplingConfig(),
         eos_id: int = 2,
         seed: int = 0,
+        adapters: Optional[list] = None,
     ) -> tuple[list[list[int]], list[str]]:
         """Batch generation via the fused path. Returns (token lists,
-        finish reasons)."""
+        finish reasons). `adapters`: per-prompt LoRA adapter names (or
+        served ids); None/"" rows ride the base model."""
         tokens, true_len, max_new_tokens = self._pack_prompts(
             prompts, max_new_tokens, self.cfg.max_seq_len
         )
+        if adapters and len(adapters) > len(prompts):
+            raise ValueError(
+                f"{len(adapters)} adapters for {len(prompts)} prompts"
+            )
+        idx = np.zeros((tokens.shape[0],), np.int32)
+        for i, name in enumerate(adapters or []):
+            if isinstance(name, int):
+                # Range-check explicitly: jnp.take clips out-of-range
+                # gathers, which would silently serve the WRONG adapter.
+                if not 0 <= name <= len(self.lora_names):
+                    raise ValueError(
+                        f"adapter id {name} out of range "
+                        f"(0..{len(self.lora_names)})"
+                    )
+                idx[i] = name
+            else:
+                idx[i] = self.resolve_adapter(name or "")
         with self.mesh:
             out, out_len = self._generate_fn(
                 self.params, jnp.asarray(tokens), jnp.asarray(true_len),
                 max_new_tokens, sampling,
                 jax.random.PRNGKey(seed), jnp.int32(eos_id),
+                jnp.asarray(idx),
             )
         return self._decode_outputs(
             np.asarray(out), np.asarray(out_len), eos_id
@@ -731,9 +858,14 @@ class GenerationEngine:
         sampling: SamplingConfig = SamplingConfig(),
         eos_id: int = 2,
         seed: int = 0,
+        adapter: str = "",
     ) -> Iterator[int]:
         """Single-sequence streaming: per-step jitted decode, yields
-        token ids as they are sampled."""
+        token ids as they are sampled. `adapter`: LoRA adapter name
+        ("" = base)."""
+        lora_idx = jnp.asarray(
+            [self.resolve_adapter(adapter)], jnp.int32
+        )
         prompt, max_new_tokens = fit_request(
             prompt, max_new_tokens, self.cfg.max_seq_len
         )
@@ -747,7 +879,8 @@ class GenerationEngine:
         with self.mesh:
             cache = self.make_cache(1, max_cache)
             last_logits, cache = self._prefill_fn(
-                self.params, jnp.asarray(tokens), jnp.asarray(true_len), cache
+                self.params, jnp.asarray(tokens), jnp.asarray(true_len),
+                cache, lora_idx,
             )
             cur = sample(last_logits, jax.random.fold_in(rng, 0),
                          sampling)
@@ -759,7 +892,8 @@ class GenerationEngine:
                 if i == max_new_tokens - 1:
                     return
                 cur, cache = self._decode_fn(
-                    self.params, cur[:, None], cache, rng, i + 1, sampling
+                    self.params, cur[:, None], cache, rng, i + 1, sampling,
+                    lora_idx,
                 )
 
     def model_info(self) -> dict:

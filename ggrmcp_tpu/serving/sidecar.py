@@ -176,6 +176,14 @@ class Sidecar:
             top_p=s.top_p if 0.0 < s.top_p < 1.0 else 1.0,
         )
 
+    async def _resolve_adapter(self, request, context) -> int:
+        """GenerateRequest.adapter name → served LoRA row id; unknown
+        names are the caller's error (INVALID_ARGUMENT), not a 500."""
+        try:
+            return self.generation.resolve_adapter(request.adapter)
+        except ValueError as exc:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+
     async def generate(self, request: serving_pb2.GenerateRequest, context):
         assert self.generation is not None and self.batcher is not None
         t0 = time.perf_counter()
@@ -186,6 +194,7 @@ class Sidecar:
         token_ids: list[int] = []
         finish = "length"
         sampling = self._sampling(request)
+        adapter = await self._resolve_adapter(request, context)
         speculative = (
             self.generation.draft_fam is not None
             and sampling.temperature <= 0.0
@@ -215,7 +224,8 @@ class Sidecar:
                 # unary: one terminal chunk — skips per-tick
                 # cross-thread emission (batching.py _Request.unary).
                 async for chunk_ids, reason in self.batcher.submit(
-                    prompt, max_new, sampling, seed, unary=True
+                    prompt, max_new, sampling, seed, unary=True,
+                    adapter=adapter,
                 ):
                     token_ids.extend(chunk_ids)
                     if reason:
@@ -244,6 +254,7 @@ class Sidecar:
             request.max_new_tokens or 64, self.serving.batching.max_decode_steps
         )
         seed = request.sampling.seed or 0
+        adapter = await self._resolve_adapter(request, context)
         emitted = ""
         stops = list(request.stop)
         all_ids: list[int] = []
@@ -261,7 +272,7 @@ class Sidecar:
             return stable[len(emitted):], stop_hit
 
         async for chunk_ids, reason in self.batcher.submit(
-            prompt, max_new, self._sampling(request), seed
+            prompt, max_new, self._sampling(request), seed, adapter=adapter
         ):
             all_ids.extend(chunk_ids)
             final = reason is not None

@@ -89,9 +89,10 @@ class TieredBatcher:
         sampling: SamplingConfig,
         seed: int = 0,
         unary: bool = False,
+        adapter: int = 0,
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         return self._route(len(prompt), max_new).submit(
-            prompt, max_new, sampling, seed, unary=unary
+            prompt, max_new, sampling, seed, unary=unary, adapter=adapter
         )
 
     def cache_bytes(self) -> int:

@@ -1,0 +1,274 @@
+"""Multi-LoRA serving (ops/lora.py): per-request adapters batched into
+one continuous batch. Covers the engine fused path, the batcher path
+(mixed adapters in one tick), the sidecar RPC field, and the config
+gates — all on the virtual 8-device CPU mesh (TP-sharded base weights
+with replicated adapter factors)."""
+
+import asyncio
+
+import grpc
+import grpc.aio
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.core.config import (
+    BatchingConfig,
+    LoraConfig,
+    MeshConfig,
+    ServingConfig,
+)
+from ggrmcp_tpu.models import llama
+from ggrmcp_tpu.ops.sampling import SamplingConfig
+from ggrmcp_tpu.rpc.pb import serving_pb2
+from ggrmcp_tpu.serving.batching import ContinuousBatcher
+from ggrmcp_tpu.serving.engine import GenerationEngine
+from ggrmcp_tpu.serving.sidecar import Sidecar
+
+
+def lora_serving(**kw) -> ServingConfig:
+    kw.setdefault("mesh", MeshConfig(tensor=2, data=0))
+    kw.setdefault(
+        "batching", BatchingConfig(max_batch_size=4, kv_cache_max_seq=256)
+    )
+    kw.setdefault("lora", LoraConfig(adapters=["acme", "beta"], rank=4))
+    return ServingConfig(**kw)
+
+
+def random_factors(cfg, rank, seed=0):
+    rng = np.random.default_rng(seed)
+    out = (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
+    a = rng.normal(0, 0.05, (cfg.num_layers, cfg.hidden_dim, rank))
+    b = rng.normal(0, 0.05, (cfg.num_layers, rank, out))
+    return a, b
+
+
+@pytest.fixture(scope="module")
+def lora_engine():
+    cfg = llama.CONFIGS["tiny-llama"]
+    eng = GenerationEngine(cfg, lora_serving())
+    eng.set_lora_weights("acme", *random_factors(cfg, 4, seed=1))
+    return eng
+
+
+class TestEngineLora:
+    def test_zero_init_adapter_is_noop(self):
+        # Fresh engine: every adapter's B factor is zero → exact base.
+        eng = GenerationEngine(llama.CONFIGS["tiny-llama"], lora_serving())
+        base, _ = eng.generate([[5, 6, 7]], max_new_tokens=6)
+        beta, _ = eng.generate([[5, 6, 7]], max_new_tokens=6,
+                               adapters=["beta"])
+        assert base == beta
+
+    def test_loaded_adapter_changes_output_and_is_isolated(
+        self, lora_engine
+    ):
+        base, _ = lora_engine.generate([[5, 6, 7]], max_new_tokens=8)
+        acme, _ = lora_engine.generate(
+            [[5, 6, 7]], max_new_tokens=8, adapters=["acme"]
+        )
+        beta, _ = lora_engine.generate(
+            [[5, 6, 7]], max_new_tokens=8, adapters=["beta"]
+        )
+        assert acme != base  # trained factors take effect
+        assert beta == base  # untouched adapter stays a no-op
+
+    def test_mixed_batch_rows_keep_their_adapters(self, lora_engine):
+        base, _ = lora_engine.generate([[5, 6, 7]], max_new_tokens=6)
+        acme, _ = lora_engine.generate(
+            [[5, 6, 7]], max_new_tokens=6, adapters=["acme"]
+        )
+        mixed, _ = lora_engine.generate(
+            [[5, 6, 7], [5, 6, 7]], max_new_tokens=6, adapters=["acme", ""]
+        )
+        assert mixed[0] == acme[0]
+        assert mixed[1] == base[0]
+
+    def test_stream_with_adapter_matches_batch(self, lora_engine):
+        streamed = list(lora_engine.generate_stream(
+            [5, 6, 7], max_new_tokens=6, adapter="acme"
+        ))
+        batched, _ = lora_engine.generate(
+            [[5, 6, 7]], max_new_tokens=6, adapters=["acme"]
+        )
+        assert streamed == batched[0]
+
+    def test_unknown_adapter_rejected(self, lora_engine):
+        with pytest.raises(ValueError, match="unknown adapter"):
+            lora_engine.generate([[5]], 4, adapters=["nope"])
+
+    def test_base_row_is_write_protected(self, lora_engine):
+        with pytest.raises(ValueError, match="base adapter"):
+            lora_engine.set_lora_weights(
+                "", *random_factors(lora_engine.cfg, 4)
+            )
+
+    def test_gates(self):
+        with pytest.raises(ValueError, match="dense Llama"):
+            from ggrmcp_tpu.models import moe
+
+            GenerationEngine(
+                moe.CONFIGS["tiny-moe"],
+                lora_serving(),
+            )
+        with pytest.raises(ValueError, match="speculative"):
+            GenerationEngine(
+                llama.CONFIGS["tiny-llama"],
+                lora_serving(speculative_draft="tiny-llama"),
+            )
+
+
+class TestBatcherLora:
+    async def _collect(self, batcher, prompt, max_new, adapter=0):
+        out: list[int] = []
+        reason = None
+        async for ids, reason in batcher.submit(
+            prompt, max_new, SamplingConfig(temperature=0.0),
+            adapter=adapter,
+        ):
+            out.extend(ids)
+        return out, reason
+
+    async def test_mixed_adapters_one_tick(self, lora_engine):
+        """Concurrent base/acme requests share the slot pool and each
+        gets its own adapter's tokens — the whole point of batched
+        multi-LoRA (no bucketing by adapter)."""
+        batcher = ContinuousBatcher(
+            lora_engine,
+            BatchingConfig(max_batch_size=4, kv_cache_max_seq=256,
+                           decode_steps_per_tick=4),
+        )
+        batcher.start()
+        try:
+            acme_id = lora_engine.resolve_adapter("acme")
+            results = await asyncio.gather(
+                self._collect(batcher, [5, 6, 7], 6, adapter=acme_id),
+                self._collect(batcher, [5, 6, 7], 6, adapter=0),
+                self._collect(batcher, [5, 6, 7], 6, adapter=acme_id),
+            )
+            solo_acme, _ = lora_engine.generate(
+                [[5, 6, 7]], max_new_tokens=6, adapters=["acme"]
+            )
+            solo_base, _ = lora_engine.generate(
+                [[5, 6, 7]], max_new_tokens=6
+            )
+            assert results[0][0] == solo_acme[0]
+            assert results[1][0] == solo_base[0]
+            assert results[2][0] == solo_acme[0]
+        finally:
+            await batcher.stop()
+
+    async def test_chunked_prefill_carries_adapter(self, lora_engine):
+        """A prompt past prefill_chunk takes the chunked admission path
+        — its chunks must run under the request's adapter too."""
+        batcher = ContinuousBatcher(
+            lora_engine,
+            BatchingConfig(max_batch_size=2, kv_cache_max_seq=256,
+                           prefill_chunk=32),
+        )
+        batcher.start()
+        try:
+            prompt = [5 + (i % 7) for i in range(48)]  # > prefill_chunk
+            acme_id = lora_engine.resolve_adapter("acme")
+            chunked, reason = await self._collect(
+                batcher, prompt, 6, adapter=acme_id
+            )
+            assert reason in ("length", "stop")
+            solo, _ = lora_engine.generate(
+                [prompt], max_new_tokens=6, adapters=["acme"]
+            )
+            assert chunked == solo[0]
+        finally:
+            await batcher.stop()
+
+
+class TestLoraSafety:
+    """Review-driven hazards: prefix-pool contamination, silent gather
+    clipping on out-of-range ids, broadcasting factor installs."""
+
+    def test_adapter_id_range_checked(self, lora_engine):
+        with pytest.raises(ValueError, match="out of range"):
+            lora_engine.generate([[5]], 4, adapters=[7])
+        with pytest.raises(ValueError, match="out of range"):
+            lora_engine.generate([[5]], 4, adapters=[-1])
+        with pytest.raises(ValueError, match="adapters for"):
+            lora_engine.generate([[5]], 4, adapters=[0, 0])
+
+    def test_factor_shapes_checked(self, lora_engine):
+        cfg = lora_engine.cfg
+        a, b = random_factors(cfg, 4)
+        with pytest.raises(ValueError, match="factor shapes"):
+            lora_engine.set_lora_weights("beta", a[0], b)  # missing L axis
+
+    async def test_prefix_pool_stays_base_only(self, lora_engine):
+        """A shared system prompt sent under an adapter must not seed
+        the pool: the base model re-sending it must get base KV (and a
+        base request's pooled entry must not serve adapter'd ones)."""
+        cfg = BatchingConfig(
+            max_batch_size=4, kv_cache_max_seq=256,
+            prefix_cache_entries=2, prefix_cache_min_seq=16,
+            prefix_cache_max_seq=64,
+        )
+        batcher = ContinuousBatcher(lora_engine, cfg)
+        batcher.start()
+        preamble = [7, 3, 9, 1] * 6  # 24 >= min_seq
+        acme_id = lora_engine.resolve_adapter("acme")
+
+        async def run(prompt, adapter):
+            out: list[int] = []
+            async for ids, reason in batcher.submit(
+                prompt, 6, SamplingConfig(temperature=0.0), adapter=adapter
+            ):
+                out.extend(ids)
+            return out
+
+        try:
+            # adapter'd request first: must NOT store its KV
+            await run(preamble + [5], acme_id)
+            assert batcher.prefix_hits == 0
+            # base request with the same preamble: a MISS (stores now)
+            base1 = await run(preamble + [5], 0)
+            assert batcher.prefix_hits == 0
+            # base again: pool hit, identical tokens
+            base2 = await run(preamble + [5], 0)
+            assert batcher.prefix_hits == 1
+            assert base2 == base1
+            # adapter'd request again: must not consult the base entry
+            hits_before = batcher.prefix_hits
+            acme = await run(preamble + [5], acme_id)
+            assert batcher.prefix_hits == hits_before
+            solo_acme, _ = lora_engine.generate(
+                [preamble + [5]], max_new_tokens=6, adapters=["acme"]
+            )
+            assert acme == solo_acme[0]
+        finally:
+            await batcher.stop()
+
+
+class TestSidecarLora:
+    async def test_adapter_field_round_trip(self):
+        serving = lora_serving()
+        side = Sidecar(serving)
+        port = await side.start(0)
+        channel = grpc.aio.insecure_channel(f"localhost:{port}")
+        gen = channel.unary_unary(
+            "/ggrmcp.tpu.GenerateService/Generate",
+            request_serializer=serving_pb2.GenerateRequest.SerializeToString,
+            response_deserializer=serving_pb2.GenerateResponse.FromString,
+        )
+        try:
+            base = await gen(serving_pb2.GenerateRequest(
+                prompt="hello", max_new_tokens=4
+            ))
+            via = await gen(serving_pb2.GenerateRequest(
+                prompt="hello", max_new_tokens=4, adapter="beta"
+            ))
+            # zero-init adapter → same tokens as base
+            assert via.text == base.text
+            with pytest.raises(grpc.aio.AioRpcError) as exc:
+                await gen(serving_pb2.GenerateRequest(
+                    prompt="hello", max_new_tokens=4, adapter="nope"
+                ))
+            assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        finally:
+            await channel.close()
+            await side.stop()
