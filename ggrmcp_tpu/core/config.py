@@ -421,6 +421,11 @@ class LoraConfig:
     # are 1..N in list order (0 = the base model). Empty = LoRA off.
     adapters: list = field(default_factory=list)
     rank: int = 8  # low-rank dimension r (factors stored pre-scaled)
+    # Directory of trained factors, one `{name}.npz` per adapter with
+    # arrays `a` [L, D, r] and `b` [L, r, (H+2KVH)*Dh] (pre-scaled by
+    # alpha/r). Missing files leave that adapter a zero-init no-op;
+    # "" loads nothing.
+    path: str = ""
 
 
 # ---------------------------------------------------------------------------

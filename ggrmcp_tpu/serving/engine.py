@@ -254,6 +254,14 @@ class GenerationEngine:
             raise ValueError("lora.rank must be >= 1")
         if len(set(adapters)) != len(adapters) or "" in adapters:
             raise ValueError("lora.adapters must be unique, non-empty names")
+        for name in adapters:
+            # Names become `{lora.path}/{name}.npz` — separators would
+            # let a config read factors from outside the directory.
+            if "/" in name or "\\" in name or name.startswith("."):
+                raise ValueError(
+                    f"lora adapter name {name!r} must be a plain name "
+                    f"(no path separators or leading dots)"
+                )
         from ggrmcp_tpu.ops import lora as lora_mod
 
         factors = lora_mod.init_lora_layers(
@@ -273,7 +281,30 @@ class GenerationEngine:
             len(adapters), adapters, self.serving.lora.rank,
             sum(v.nbytes for v in factors.values()) / 1e6,
         )
+        if self.serving.lora.path:
+            self.params = params  # set_lora_weights reads/writes it
+            self._load_lora_dir(self.serving.lora.path)
+            params = self.params
         return params
+
+    def _load_lora_dir(self, path: str) -> None:
+        """Load trained factors from `{path}/{name}.npz` (arrays `a`,
+        `b`; LoraConfig.path contract). A missing file leaves that
+        adapter a zero-init no-op; a present-but-wrong file is a
+        configuration error and fails loudly."""
+        import os
+
+        for name in self.lora_names:
+            f = os.path.join(path, f"{name}.npz")
+            if not os.path.exists(f):
+                logger.info("lora: no factors at %s (adapter stays no-op)", f)
+                continue
+            with np.load(f) as data:
+                try:
+                    self.set_lora_weights(name, data["a"], data["b"])
+                except (KeyError, ValueError) as exc:
+                    raise ValueError(f"lora factors {f}: {exc}") from exc
+            logger.info("lora: loaded %s", f)
 
     def resolve_adapter(self, name: str) -> int:
         """Adapter name → served row id (0 = base; raises on unknown)."""

@@ -34,11 +34,11 @@ def lora_serving(**kw) -> ServingConfig:
     return ServingConfig(**kw)
 
 
-def random_factors(cfg, rank, seed=0):
+def random_factors(cfg, rank, seed=0, scale=0.05):
     rng = np.random.default_rng(seed)
     out = (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
-    a = rng.normal(0, 0.05, (cfg.num_layers, cfg.hidden_dim, rank))
-    b = rng.normal(0, 0.05, (cfg.num_layers, rank, out))
+    a = rng.normal(0, scale, (cfg.num_layers, cfg.hidden_dim, rank))
+    b = rng.normal(0, scale, (cfg.num_layers, rank, out))
     return a, b
 
 
@@ -242,6 +242,65 @@ class TestLoraSafety:
             assert acme == solo_acme[0]
         finally:
             await batcher.stop()
+
+
+class TestLoraPersistence:
+    def test_factors_load_from_npz_dir(self, tmp_path):
+        cfg = llama.CONFIGS["tiny-llama"]
+        # Scale well past the tiny random model's argmax margin — the
+        # assertion is "loaded factors take effect", not subtlety.
+        a, b = random_factors(cfg, 4, seed=3, scale=0.5)
+        np.savez(tmp_path / "acme.npz", a=a, b=b)
+        # beta.npz intentionally absent → stays a no-op
+        eng = GenerationEngine(
+            cfg, lora_serving(
+                lora=LoraConfig(
+                    adapters=["acme", "beta"], rank=4, path=str(tmp_path)
+                )
+            ),
+        )
+        base, _ = eng.generate([[5, 6, 7]], max_new_tokens=6)
+        acme, _ = eng.generate(
+            [[5, 6, 7]], max_new_tokens=6, adapters=["acme"]
+        )
+        beta, _ = eng.generate(
+            [[5, 6, 7]], max_new_tokens=6, adapters=["beta"]
+        )
+        assert acme != base  # loaded factors applied
+        assert beta == base  # missing file → no-op
+
+        # loaded-from-disk equals set_lora_weights with the same arrays
+        eng2 = GenerationEngine(
+            cfg, lora_serving(
+                lora=LoraConfig(adapters=["acme", "beta"], rank=4)
+            ),
+        )
+        eng2.set_lora_weights("acme", a, b)
+        acme2, _ = eng2.generate(
+            [[5, 6, 7]], max_new_tokens=6, adapters=["acme"]
+        )
+        assert acme2 == acme
+
+    def test_path_traversal_names_rejected(self):
+        cfg = llama.CONFIGS["tiny-llama"]
+        for bad in ("../other", "a/b", ".hidden"):
+            with pytest.raises(ValueError, match="plain name"):
+                GenerationEngine(
+                    cfg, lora_serving(
+                        lora=LoraConfig(adapters=[bad], rank=4)
+                    ),
+                )
+
+    def test_bad_factor_file_fails_loudly(self, tmp_path):
+        cfg = llama.CONFIGS["tiny-llama"]
+        np.savez(tmp_path / "acme.npz", a=np.zeros((2, 2)))  # no `b`, bad shape
+        with pytest.raises(ValueError, match="lora factors"):
+            GenerationEngine(
+                cfg, lora_serving(
+                    lora=LoraConfig(adapters=["acme"], rank=4,
+                                    path=str(tmp_path))
+                ),
+            )
 
 
 class TestSidecarLora:
