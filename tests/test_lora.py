@@ -34,6 +34,17 @@ def lora_serving(**kw) -> ServingConfig:
     return ServingConfig(**kw)
 
 
+async def collect(batcher, prompt, max_new, adapter=0):
+    """Submit and drain one request: (tokens, finish_reason)."""
+    out: list[int] = []
+    reason = None
+    async for ids, reason in batcher.submit(
+        prompt, max_new, SamplingConfig(temperature=0.0), adapter=adapter
+    ):
+        out.extend(ids)
+    return out, reason
+
+
 def random_factors(cfg, rank, seed=0, scale=0.05):
     rng = np.random.default_rng(seed)
     out = (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
@@ -118,16 +129,6 @@ class TestEngineLora:
 
 
 class TestBatcherLora:
-    async def _collect(self, batcher, prompt, max_new, adapter=0):
-        out: list[int] = []
-        reason = None
-        async for ids, reason in batcher.submit(
-            prompt, max_new, SamplingConfig(temperature=0.0),
-            adapter=adapter,
-        ):
-            out.extend(ids)
-        return out, reason
-
     async def test_mixed_adapters_one_tick(self, lora_engine):
         """Concurrent base/acme requests share the slot pool and each
         gets its own adapter's tokens — the whole point of batched
@@ -141,9 +142,9 @@ class TestBatcherLora:
         try:
             acme_id = lora_engine.resolve_adapter("acme")
             results = await asyncio.gather(
-                self._collect(batcher, [5, 6, 7], 6, adapter=acme_id),
-                self._collect(batcher, [5, 6, 7], 6, adapter=0),
-                self._collect(batcher, [5, 6, 7], 6, adapter=acme_id),
+                collect(batcher, [5, 6, 7], 6, adapter=acme_id),
+                collect(batcher, [5, 6, 7], 6, adapter=0),
+                collect(batcher, [5, 6, 7], 6, adapter=acme_id),
             )
             solo_acme, _ = lora_engine.generate(
                 [[5, 6, 7]], max_new_tokens=6, adapters=["acme"]
@@ -169,7 +170,7 @@ class TestBatcherLora:
         try:
             prompt = [5 + (i % 7) for i in range(48)]  # > prefill_chunk
             acme_id = lora_engine.resolve_adapter("acme")
-            chunked, reason = await self._collect(
+            chunked, reason = await collect(
                 batcher, prompt, 6, adapter=acme_id
             )
             assert reason in ("length", "stop")
@@ -213,28 +214,20 @@ class TestLoraSafety:
         preamble = [7, 3, 9, 1] * 6  # 24 >= min_seq
         acme_id = lora_engine.resolve_adapter("acme")
 
-        async def run(prompt, adapter):
-            out: list[int] = []
-            async for ids, reason in batcher.submit(
-                prompt, 6, SamplingConfig(temperature=0.0), adapter=adapter
-            ):
-                out.extend(ids)
-            return out
-
         try:
             # adapter'd request first: must NOT store its KV
-            await run(preamble + [5], acme_id)
+            await collect(batcher, preamble + [5], 6, adapter=acme_id)
             assert batcher.prefix_hits == 0
             # base request with the same preamble: a MISS (stores now)
-            base1 = await run(preamble + [5], 0)
+            base1, _ = await collect(batcher, preamble + [5], 6)
             assert batcher.prefix_hits == 0
             # base again: pool hit, identical tokens
-            base2 = await run(preamble + [5], 0)
+            base2, _ = await collect(batcher, preamble + [5], 6)
             assert batcher.prefix_hits == 1
             assert base2 == base1
             # adapter'd request again: must not consult the base entry
             hits_before = batcher.prefix_hits
-            acme = await run(preamble + [5], acme_id)
+            acme, _ = await collect(batcher, preamble + [5], 6, adapter=acme_id)
             assert batcher.prefix_hits == hits_before
             solo_acme, _ = lora_engine.generate(
                 [preamble + [5]], max_new_tokens=6, adapters=["acme"]
@@ -242,6 +235,81 @@ class TestLoraSafety:
             assert acme == solo_acme[0]
         finally:
             await batcher.stop()
+
+
+class TestLoraCompositions:
+    """LoRA × the serving machinery it must ride: pipelined ticks
+    (owner snapshots + device-resident feedback + per-slot adapter
+    arrays), length-tiered pools, and int8 weight quantization (the
+    delta applies on top of a QuantizedArray qkv matmul)."""
+
+    async def test_mixed_adapters_under_pipelined_ticks(self, lora_engine):
+        batcher = ContinuousBatcher(
+            lora_engine,
+            BatchingConfig(
+                max_batch_size=4, kv_cache_max_seq=256,
+                decode_steps_per_tick=4, pipeline_ticks="on",
+            ),
+        )
+        batcher.start()
+        try:
+            acme_id = lora_engine.resolve_adapter("acme")
+            got = await asyncio.gather(
+                *(collect(batcher, [5, 6, 7], 6, adapter=acme_id if i % 2 else 0)
+                  for i in range(6))
+            )
+            solo_acme, _ = lora_engine.generate(
+                [[5, 6, 7]], max_new_tokens=6, adapters=["acme"]
+            )
+            solo_base, _ = lora_engine.generate([[5, 6, 7]], max_new_tokens=6)
+            for i, (out, _) in enumerate(got):
+                assert out == (solo_acme[0] if i % 2 else solo_base[0])
+        finally:
+            await batcher.stop()
+
+    async def test_adapter_routes_through_tiers(self, lora_engine):
+        from ggrmcp_tpu.serving.tiered import TieredBatcher
+
+        batcher = TieredBatcher(
+            lora_engine,
+            BatchingConfig(
+                max_batch_size=4, kv_cache_max_seq=128,
+                kv_tiers=[[64, 2], [128, 2]],
+            ),
+        )
+        batcher.start()
+        try:
+            acme_id = lora_engine.resolve_adapter("acme")
+            short, _ = await collect(batcher, [5, 6, 7], 6, adapter=acme_id)
+            long_p = [5 + (i % 7) for i in range(80)]  # → bigger tier
+            long_out, _ = await collect(batcher, long_p, 6, adapter=acme_id)
+            solo_s, _ = lora_engine.generate(
+                [[5, 6, 7]], max_new_tokens=6, adapters=["acme"]
+            )
+            solo_l, _ = lora_engine.generate(
+                [long_p], max_new_tokens=6, adapters=["acme"]
+            )
+            assert short == solo_s[0]
+            assert long_out == solo_l[0]
+        finally:
+            await batcher.stop()
+
+    def test_lora_on_int8_weights(self):
+        cfg = llama.CONFIGS["tiny-llama"]
+        eng = GenerationEngine(
+            cfg, lora_serving(quantize="int8"),
+        )
+        base, _ = eng.generate([[5, 6, 7]], max_new_tokens=6)
+        noop, _ = eng.generate(
+            [[5, 6, 7]], max_new_tokens=6, adapters=["acme"]
+        )
+        assert noop == base  # zero-init delta on the quantized matmul
+        eng.set_lora_weights("acme", *random_factors(cfg, 4, seed=2,
+                                                     scale=0.5))
+        tuned, _ = eng.generate(
+            [[5, 6, 7]], max_new_tokens=6, adapters=["acme"]
+        )
+        assert tuned != base
 
 
 class TestLoraPersistence:
