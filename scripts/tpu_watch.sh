@@ -239,10 +239,13 @@ run_ladder() {
   have_attn                      || stage_attn || probe || return 1
   have_bench bench_tpu_int8.json || stage_int8 || probe || return 1
   have_bench bench_tpu_8b.json   || stage_8b   || probe || return 1
+  # Rebank BEFORE the tuning A/B: in a short late-round window the
+  # fresh full-phase flagship capture (which feeds BENCH_r{N}) is
+  # worth more than the tuning points.
+  [ -f "$ART/.rebanked_1b" ] || stage_rebank_1b || probe || return 1
   have_bench bench_tpu_int8_t16.json || stage_1b_t16 || probe || return 1
   have_bench bench_tpu_8b_t16.json   || stage_8b_t16 || probe || return 1
   have_bench bench_tpu_int8_nopipe.json || stage_1b_nopipe || probe || return 1
-  [ -f "$ART/.rebanked_1b" ] || stage_rebank_1b || probe || return 1
   return 0
 }
 
