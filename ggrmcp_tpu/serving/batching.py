@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ggrmcp_tpu.core.config import BatchingConfig
+from ggrmcp_tpu.core.config import BatchingConfig, resolve_decode_steps
 from ggrmcp_tpu.models import llama as llama_mod
 from ggrmcp_tpu.ops import quant
 from ggrmcp_tpu.ops.sampling import SamplingConfig, sample_dynamic
@@ -80,6 +80,11 @@ class _Request:
     acc: list[int] = dataclasses.field(default_factory=list)
     # LoRA adapter row id (0 = base model; ops/lora.py).
     adapter: int = 0
+    # Latency accounting (perf_counter seconds): submit → activation
+    # is queue time, activation → terminal chunk is service time.
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    queue_ms: float = 0.0
 
 
 class ContinuousBatcher:
@@ -105,7 +110,8 @@ class ContinuousBatcher:
         self._stopping = False
 
         b = self.cfg.max_batch_size
-        self._steps_per_tick = max(1, self.cfg.decode_steps_per_tick)
+        platform = engine.mesh.devices.flat[0].platform
+        self._steps_per_tick = resolve_decode_steps(self.cfg, platform)
         # Pipelined ticks: tick N+1 is dispatched (device-resident token
         # feedback) before tick N's tokens are pulled to the host, so
         # the host round-trip overlaps the next tick's compute. A slot
@@ -115,8 +121,7 @@ class ContinuousBatcher:
         # overlap with: on CPU the lagged tick is pure extra compute.
         mode = getattr(self.cfg, "pipeline_ticks", "off")
         self._pipeline = mode == "on" or (
-            mode == "auto"
-            and engine.mesh.devices.flat[0].platform == "tpu"
+            mode == "auto" and platform == "tpu"
         )
         self._reserve = (
             2 * self._steps_per_tick - 1 if self._pipeline
@@ -199,6 +204,29 @@ class ContinuousBatcher:
         self.prefix_hits = 0
         self.prefix_misses = 0
 
+        # Per-tick timing breakdown (all cumulative ms / counts; the
+        # bench artifact and /stats derive averages). dispatch = host
+        # time to build+launch a tick (async under JAX — device compute
+        # is NOT included); collect = blocking host pull of a tick's
+        # tokens (device wait + transfer); admit = executor time for a
+        # whole admission round (device prefill + activation).
+        self.timing = {
+            "tick_dispatch_ms": 0.0,
+            "tick_collect_ms": 0.0,
+            "admit_ms": 0.0,
+            "ticks": 0,
+            "collects": 0,
+            "admit_rounds": 0,
+        }
+        # (queue_ms, service_ms) per completed request — queue = submit
+        # to slot activation, service = activation to terminal chunk.
+        self._lat_records: deque = deque(maxlen=4096)
+        # EMA of per-row admission cost, feeding the p50_budget_ms
+        # admission cap (start pessimistic so a cold first round under
+        # an SLO config stays small until measured).
+        self._admit_ema_ms = 50.0
+        self.timed_out = 0
+
         # jitted: one decode tick for the whole slot pool (params ride
         # as an argument — a closed-over weight tree would be lowered
         # into the module as constants, bloating compiles and defeating
@@ -219,6 +247,21 @@ class ContinuousBatcher:
         # [1, C, ·] instead of [1, S, ·] (bounded memory at long S).
         self._chunk_step = jax.jit(self._chunk_step_impl, donate_argnums=(2,))
         self._insert_row = jax.jit(self._insert_row_impl, donate_argnums=(0,))
+        # Fused chunked admission: the WHOLE multi-chunk prefill of an
+        # admission group — mini-cache creation, lax.scan over [T, C]
+        # chunk steps, per-row final-logit select, shared-cache merge,
+        # first-token sample — in ONE device call. Over a remote device
+        # link this is the difference between ~(4 + chunks)·rows round
+        # trips and one (round-4 prefix-reuse p50 was 23 s for exactly
+        # this reason). The _pfx variant additionally seeds every row
+        # from a prefix-pool entry before the scan (pool NOT donated —
+        # stores are rare and an undonated pool survives call failure).
+        self._admit_chunked = jax.jit(
+            self._admit_chunked_impl, donate_argnums=(3,)
+        )
+        self._admit_chunked_pfx = jax.jit(
+            self._admit_chunked_pfx_impl, donate_argnums=(3,)
+        )
         self._first_token = jax.jit(self._first_token_impl)
         # Prefix-pool store/load. The POOL is deliberately NOT donated:
         # stores are rare (first sighting of a prefix), entries are
@@ -290,6 +333,108 @@ class ContinuousBatcher:
         v = quant.kv_map(select, cache.v, mini.v)
         lengths = jnp.where(valid, true_len, cache.length)
         return first, llama_mod.KVCache(k=k, v=v, length=lengths)
+
+    def _chunked_scan(self, params, tokens, true_len, mini, adapters, start):
+        """lax.scan over a [B, T, C] chunk grid: each step extends
+        `mini` (which must already hold `start` positions per row) by
+        one [B, C] chunk and captures the logits at each row's final
+        prompt position as it passes. Rows shorter than the grid
+        process padding chunks whose K/V land past their final length
+        (masked on merge, exactly like the serial chunked path).
+        Returns (final_logits [B, V] f32, mini)."""
+        b, t_steps, c = tokens.shape
+        carry0 = jnp.zeros((b, self.engine.cfg.vocab_size), jnp.float32)
+        last = true_len - 1  # absolute index of each row's final token
+
+        def body(carry, xs):
+            mini, fl = carry
+            chunk, off = xs
+            if self._is_moe:
+                valid = (off + jnp.arange(c))[None, :] < true_len[:, None]
+            else:
+                valid = None
+            logits, mini = self.engine.decode_forward(
+                params, chunk, mini, valid=valid, ring=self._ring,
+                lora_idx=adapters,
+            )
+            idx = jnp.clip(last - off, 0, c - 1)
+            sel = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1
+            )[:, 0]
+            take = (last >= off) & (last < off + c)
+            fl = jnp.where(take[:, None], sel.astype(fl.dtype), fl)
+            return (mini, fl), None
+
+        offs = start + jnp.arange(t_steps, dtype=jnp.int32) * c
+        (mini, fl), _ = jax.lax.scan(
+            body, (mini, carry0), (jnp.moveaxis(tokens, 1, 0), offs)
+        )
+        return fl, mini
+
+    def _chunked_finish(
+        self, cache, mini, valid, true_len, fl, seeds, temps, ks, ps
+    ):
+        """Merge the admission mini (full cache width) into the shared
+        cache at the valid rows and sample each row's first token —
+        the same row-select as _admit_full_impl, so no scatter
+        hazards."""
+        first = sample_dynamic(fl, seeds, jnp.int32(0), temps, ks, ps)
+        sel = valid[None, :, None, None, None]
+
+        def select(c_, m):
+            return jnp.where(sel, m.astype(c_.dtype), c_)
+
+        k = quant.kv_map(select, cache.k, mini.k)
+        v = quant.kv_map(select, cache.v, mini.v)
+        lengths = jnp.where(valid, true_len, cache.length)
+        return first, llama_mod.KVCache(k=k, v=v, length=lengths)
+
+    def _admit_chunked_impl(
+        self, params, tokens, true_len, cache, valid, seeds, temps, ks,
+        ps, adapters,
+    ):
+        """Fused chunked admission (no prefix): the whole [B, T, C]
+        prefill grid + merge + first-token sample, ONE device call."""
+        b = tokens.shape[0]
+        mini = self._make_mini(b, self.max_seq)
+        fl, mini = self._chunked_scan(
+            params, tokens, true_len, mini, adapters, jnp.int32(0)
+        )
+        return self._chunked_finish(
+            cache, mini, valid, true_len, fl, seeds, temps, ks, ps
+        )
+
+    def _admit_chunked_pfx_impl(
+        self, params, tokens, true_len, cache, valid, seeds, temps, ks,
+        ps, adapters, pool, entry, start,
+    ):
+        """Fused prefix-reuse admission: pool entry `entry` seeds the
+        first `start` positions of EVERY row, then the [B, 1, W] suffix
+        grid runs from `start`. One device call admits a whole wave of
+        same-preamble requests — the agentic arrival shape."""
+        b = tokens.shape[0]
+        mini = self._make_mini(b, self.max_seq)
+
+        def load(m, p):
+            row = jax.lax.dynamic_slice_in_dim(p, entry, 1, axis=1)
+            row = jnp.broadcast_to(
+                row, row.shape[:1] + (b,) + row.shape[2:]
+            )
+            return jax.lax.dynamic_update_slice(
+                m, row.astype(m.dtype), (0,) * m.ndim
+            )
+
+        mini = llama_mod.KVCache(
+            k=quant.kv_map(load, mini.k, pool.k),
+            v=quant.kv_map(load, mini.v, pool.v),
+            length=jnp.full((b,), start, jnp.int32),
+        )
+        fl, mini = self._chunked_scan(
+            params, tokens, true_len, mini, adapters, start
+        )
+        return self._chunked_finish(
+            cache, mini, valid, true_len, fl, seeds, temps, ks, ps
+        )
 
     def _tick_impl(
         self, params, tokens, cache, seeds, step, temps, ks, ps, active,
@@ -642,6 +787,8 @@ class ContinuousBatcher:
         slot.generated = 0
         slot.max_new = request.max_new
         slot.done = False
+        request.t_admit = time.perf_counter()
+        request.queue_ms = (request.t_admit - request.t_submit) * 1000.0
         self.cur_tokens[slot_idx] = first_tok
         if self._cur_dev is not None:
             self._cur_dev = self._cur_dev.at[slot_idx].set(first_tok)
@@ -697,22 +844,80 @@ class ContinuousBatcher:
             jnp.asarray(np.zeros((b,), bool)),
             jnp.asarray(np.zeros((b,), np.int32)),
         )
-        # Chunked-prefill programs (statically shaped: [1, C] chunk into
-        # a [1, S_max] mini cache) — the first long-prompt request must
-        # not pay their compiles. Skipped when the chunked path is
-        # unreachable (every admissible prompt fits one chunk and no
-        # prefix pool routes short prompts through it).
-        if (
-            self.cfg.prefill_chunk < self.max_seq
-            or self._pfx_pool is not None
-            or self._ring
-        ):
-            c = min(self.cfg.prefill_chunk, self.max_seq)
+        # Fused chunked-admission programs. The long-prompt grid
+        # ([B, T, C]) compiles per distinct T — warm the single-chunk
+        # grid when the chunked path is reachable (deeper grids compile
+        # on their first long prompt; callers that care, like the
+        # bench, send one long warmup request off the clock).
+        b_rows = len(self.slots)
+        zlenb = np.zeros((b_rows,), np.int32)
+        zvalid = np.zeros((b_rows,), bool)
+        zseedb = np.zeros((b_rows,), np.uint32)
+        zfb = np.zeros((b_rows,), np.float32)
+        zib = np.zeros((b_rows,), np.int32)
+        ofb = np.ones((b_rows,), np.float32)
+        c = min(self.cfg.prefill_chunk, self.max_seq)
+        if self.cfg.prefill_chunk < self._fit_limit or self._ring:
+            _, self.cache = self._admit_chunked(
+                self.engine.params,
+                jnp.asarray(np.zeros((b_rows, 1, c), np.int32)),
+                jnp.asarray(zlenb), self.cache, jnp.asarray(zvalid),
+                jnp.asarray(zseedb), jnp.asarray(zfb), jnp.asarray(zib),
+                jnp.asarray(ofb), jnp.asarray(zib),
+            )
+        if self._pfx_pool is not None:
+            # plen=0 and no host-side key: the warmup entry can never
+            # match a lookup. Store programs first (mini from a plain
+            # make — stores only copy rows, no forward needed).
             mini = self._make_mini(1, self.max_seq)
+            self._pfx_pool = self._pfx_store(
+                self._pfx_pool, mini, jnp.int32(0), jnp.int32(0)
+            )
+            # Burst/trickle learning stores from a shared-cache row —
+            # warm that program too, or the first store pays its
+            # compile inline.
+            self._pfx_pool = self._pfx_store_slot(
+                self._pfx_pool, self.cache, jnp.int32(0),
+                jnp.int32(0), jnp.int32(0),
+            )
+            # Warm the fused prefix admission for every suffix-width
+            # bucket a hit can pick ([B, 1, 32] .. [B, 1, bucket(c)])
+            # — a hit wave's first use must not pay a cold compile
+            # mid-request (minutes over a remote-compile TPU link).
+            width = 32
+            while width <= bucket_len(c, maximum=self.max_seq):
+                _, self.cache = self._admit_chunked_pfx(
+                    self.engine.params,
+                    jnp.asarray(np.zeros((b_rows, 1, width), np.int32)),
+                    jnp.asarray(zlenb), self.cache, jnp.asarray(zvalid),
+                    jnp.asarray(zseedb), jnp.asarray(zfb),
+                    jnp.asarray(zib), jnp.asarray(ofb), jnp.asarray(zib),
+                    self._pfx_pool, jnp.int32(0), jnp.int32(0),
+                )
+                width *= 2
+            # The SERIAL fallback (_prefill_chunked) still serves
+            # prefix hits whose suffix needs a multi-step bridge plan
+            # (suffix > prefill_chunk). Warm its programs too —
+            # _pfx_load, the [1, w] bridge/chunk steps, _insert_row,
+            # _first_token — or that path pays cold compiles inline
+            # while admission and ticks share the serialized executor.
+            mini = self._pfx_load(
+                self._make_mini(1, self.max_seq), self._pfx_pool,
+                jnp.int32(0), jnp.int32(0),
+            )
             logits, mini = self._chunk_step(
                 self.engine.params, jnp.asarray(np.zeros((1, c), np.int32)),
                 mini, jnp.asarray(zlen1), jnp.asarray(zi1),
             )
+            width = 32
+            while width <= bucket_len(c, maximum=self.max_seq):
+                if width != c:
+                    logits, mini = self._chunk_step(
+                        self.engine.params,
+                        jnp.asarray(np.zeros((1, width), np.int32)),
+                        mini, jnp.asarray(zlen1), jnp.asarray(zi1),
+                    )
+                width *= 2
             self.cache = self._insert_row(
                 self.cache, mini, jnp.int32(0), jnp.int32(0)
             )
@@ -720,36 +925,6 @@ class ContinuousBatcher:
                 logits, jnp.asarray(zi1), jnp.asarray(zseed1),
                 jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
             )
-            if self._pfx_pool is not None:
-                # plen=0 and no host-side key: the warmup entry can
-                # never match a lookup.
-                self._pfx_pool = self._pfx_store(
-                    self._pfx_pool, mini, jnp.int32(0), jnp.int32(0)
-                )
-                # Burst learning stores from a shared-cache row — warm
-                # that program too (same never-matches plen=0 entry),
-                # or the first cold burst pays its compile inline.
-                self._pfx_pool = self._pfx_store_slot(
-                    self._pfx_pool, self.cache, jnp.int32(0),
-                    jnp.int32(0), jnp.int32(0),
-                )
-                # _pfx_load donates its mini: keep the returned one.
-                mini = self._pfx_load(
-                    mini, self._pfx_pool, jnp.int32(0), jnp.int32(0)
-                )
-                # Warm every suffix-step bucket a prefix hit can pick
-                # ([1, 32] .. [1, bucket(c)]) — a hit's first use must
-                # not pay a cold compile mid-request (minutes over a
-                # remote-compile TPU link).
-                width = 32
-                while width <= bucket_len(c, maximum=self.max_seq):
-                    if width != c:
-                        _, mini = self._chunk_step(
-                            self.engine.params,
-                            jnp.asarray(np.zeros((1, width), np.int32)),
-                            mini, jnp.asarray(zlen1), jnp.asarray(zi1),
-                        )
-                    width *= 2
         jax.block_until_ready(self.cache.k)
 
     def start(self) -> None:
@@ -769,7 +944,7 @@ class ContinuousBatcher:
                 pass
             self._task = None
 
-    async def submit(
+    def submit(
         self,
         prompt: list[int],
         max_new: int,
@@ -783,8 +958,13 @@ class ContinuousBatcher:
         (non-streaming consumers): one terminal chunk with all tokens —
         same iterator contract, a fraction of the cross-thread events
         (see _Request.unary). `adapter`: LoRA adapter row id (0 = base;
-        resolve names via engine.resolve_adapter)."""
-        # Range-check the adapter row here (names resolve upstream):
+        resolve names via engine.resolve_adapter).
+
+        Validation runs HERE, eagerly, not at first iteration of the
+        returned generator — a caller that enqueues several requests
+        before consuming any sees the bad-argument error at the call
+        site."""
+        # Range-check the adapter row (names resolve upstream):
         # jnp.take clips out-of-range gathers, which would silently
         # serve the WRONG adapter's factors.
         n_adapters = len(getattr(self.engine, "lora_names", {}))
@@ -801,8 +981,13 @@ class ContinuousBatcher:
         )
         request = _Request(
             prompt=prompt, max_new=max_new, sampling=sampling, seed=seed,
-            unary=unary, adapter=adapter,
+            unary=unary, adapter=adapter, t_submit=time.perf_counter(),
         )
+        return self._consume(request)
+
+    async def _consume(
+        self, request: _Request
+    ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         await self.pending.put(request)
         self._wake.set()
         try:
@@ -821,10 +1006,51 @@ class ContinuousBatcher:
             total += self._pfx_pool.k.nbytes + self._pfx_pool.v.nbytes
         return total
 
+    def lat_snapshot(self) -> list[tuple[float, float]]:
+        """Snapshot of recent (queue_ms, service_ms) records (the
+        tiered facade concatenates these across tiers)."""
+        return list(self._lat_records)
+
+    @staticmethod
+    def lat_percentiles(records: list[tuple[float, float]]) -> dict:
+        """Queue/service percentiles from (queue_ms, service_ms)
+        records — the queue-time vs device-time split the SLO policy
+        is judged on."""
+        if not records:
+            return {
+                "queue_ms_p50": 0.0, "queue_ms_p99": 0.0,
+                "service_ms_p50": 0.0, "service_ms_p99": 0.0,
+            }
+
+        def pct(vals: list[float], p: float) -> float:
+            # Nearest-rank: ceil(n*p)-th smallest — at n=100, p99 is
+            # vals[98], not the window max.
+            vals = sorted(vals)
+            idx = max(0, -(-len(vals) * p // 1) - 1)
+            return round(vals[min(len(vals) - 1, int(idx))], 2)
+
+        qs = [r[0] for r in records]
+        ss = [r[1] for r in records]
+        return {
+            "queue_ms_p50": pct(qs, 0.5), "queue_ms_p99": pct(qs, 0.99),
+            "service_ms_p50": pct(ss, 0.5), "service_ms_p99": pct(ss, 0.99),
+        }
+
     def stats(self) -> dict:
-        """Live counters for the ServingStats RPC / diagnostics. Reads
-        are loop-side snapshots of host state the executor mutates —
-        monotonic counters and slot flags, safe to read stale."""
+        """Live counters + latency percentiles for the ServingStats
+        RPC / diagnostics."""
+        return {
+            **self.counter_stats(),
+            **self.lat_percentiles(self.lat_snapshot()),
+        }
+
+    def counter_stats(self) -> dict:
+        """Summable counters only (no percentiles) — what the tiered
+        facade aggregates across tiers before computing percentiles
+        ONCE over the concatenated records. Reads are loop-side
+        snapshots of host state the executor mutates — monotonic
+        counters and slot flags, safe to read stale."""
+        t = self.timing
         return {
             "active_slots": self._active_count(),
             "total_slots": len(self.slots),
@@ -833,6 +1059,17 @@ class ContinuousBatcher:
             "prefix_cache_hits": self.prefix_hits,
             "prefix_cache_misses": self.prefix_misses,
             "decode_steps": self.step_counter,
+            "timed_out": self.timed_out,
+            # Per-tick timing breakdown (cumulative ms + counts):
+            # dispatch = host-side tick launch, collect = blocking
+            # token pull (device wait + transfer), admit = full
+            # admission rounds including device prefill.
+            "ticks": t["ticks"],
+            "tick_collects": t["collects"],
+            "admit_rounds": t["admit_rounds"],
+            "tick_dispatch_ms": round(t["tick_dispatch_ms"], 2),
+            "tick_collect_ms": round(t["tick_collect_ms"], 2),
+            "admit_ms": round(t["admit_ms"], 2),
         }
 
     # -- the loop -----------------------------------------------------------
@@ -913,9 +1150,24 @@ class ContinuousBatcher:
         admitted = 0
         deadline = time.monotonic() + self.cfg.max_queue_delay_ms / 1000.0
         loop = asyncio.get_running_loop()
-        while self._free_slots():
+        capped = False
+        while self._free_slots() and not capped:
             batch: list[_Request] = []
             budget = len(self._free_slots())
+            if self.cfg.p50_budget_ms > 0 and self._active_count() > 0:
+                # Latency SLO: while slots are decoding, one admission
+                # round may stall them by at most p50_budget_ms/4 —
+                # cap the batch at what the measured per-row prefill
+                # cost (EMA) predicts fits. One capped batch per call;
+                # the rest of the queue waits a tick (decode progress
+                # between admissions is the whole point of the cap).
+                stall_ms = self.cfg.p50_budget_ms / 4.0
+                cap = max(
+                    1, int(stall_ms / max(self._admit_ema_ms, 1e-3))
+                )
+                if cap < budget:
+                    budget = cap
+                    capped = True
             while len(batch) < budget:
                 try:
                     timeout = deadline - time.monotonic()
@@ -930,8 +1182,18 @@ class ContinuousBatcher:
                         )
                 except (asyncio.TimeoutError, asyncio.QueueEmpty):
                     break
-                if not request.cancelled:
-                    batch.append(request)
+                if request.cancelled:
+                    continue
+                ddl = self.cfg.queue_deadline_ms
+                if ddl > 0 and (
+                    time.perf_counter() - request.t_submit
+                ) * 1000.0 > ddl:
+                    # Expired in queue: fail fast instead of spending
+                    # prefill on a call the client has abandoned.
+                    self.timed_out += 1
+                    request.out.put_nowait(([], "timeout"))
+                    continue
+                batch.append(request)
             if not batch:
                 break
             slots_idx = self._free_slots()[: len(batch)]
@@ -986,12 +1248,18 @@ class ContinuousBatcher:
     def _prefill_into_slots(
         self, slots_idx: list[int], batch: list[_Request]
     ) -> None:
-        """Route each admission. Prefix-pool hits, prompts longer than
-        cfg.prefill_chunk, and store-worthy first sightings of a
-        poolable prefix take the chunked path one by one; the rest are
-        fused into one device call."""
+        """Route each admission. Short cold prompts fuse into one
+        prefill call (_prefill_fused); prefix-pool hits group by
+        identical step geometry and long prompts group wholesale, each
+        group admitted by ONE fused chunked device call
+        (_admit_chunked_group). Only a prefix hit whose suffix needs a
+        multi-step bridge plan (rare: pooled prefix + suffix longer
+        than prefill_chunk) falls back to the serial per-row path."""
+        t0 = time.perf_counter()
         fused_slots: list[int] = []
         fused_batch: list[_Request] = []
+        pfx_groups: dict[tuple, list[tuple[int, _Request]]] = {}
+        long_rows: list[tuple[int, _Request]] = []
         trickle = len(batch) == 1
         for sl, req in zip(slots_idx, batch):
             # The prefix pool holds BASE-model KV only: a pooled prefix
@@ -1007,24 +1275,121 @@ class ContinuousBatcher:
                 # overstates the pool's effectiveness.
                 self.prefix_misses += 1
             if pfx is not None:
-                self._prefill_chunked(sl, req, pfx)
-            elif len(req.prompt) > self.cfg.prefill_chunk or (
-                # First sighting of a poolable prefix: divert through
-                # the chunked path (whose mini cache feeds the pool
-                # store) only on trickle admissions — a burst of
-                # distinct prompts stays ONE fused device call instead
-                # of N serial chunked ones; shared prefixes in a burst
-                # are learned AFTER the fused call from one admitted
-                # row's cache slice (_pfx_learn_from_burst).
-                trickle and req.adapter == 0
-                and self._pfx_storable(req.prompt) is not None
-            ):
-                self._prefill_chunked(sl, req)
+                entry, plen = pfx
+                start, steps = self._pfx_plan(len(req.prompt), plen)
+                if len(steps) == 1:
+                    # Bucketed widths make same-preamble waves share a
+                    # geometry key even when question lengths differ.
+                    key = (entry, start, steps[0][1])
+                    pfx_groups.setdefault(key, []).append((sl, req))
+                else:
+                    self._prefill_chunked(sl, req, pfx)
+            elif len(req.prompt) > self.cfg.prefill_chunk:
+                long_rows.append((sl, req))
             else:
                 fused_slots.append(sl)
                 fused_batch.append(req)
+        if long_rows:
+            self._admit_chunked_group(long_rows)
+        for (entry, start, width), rows in pfx_groups.items():
+            self._admit_chunked_group(rows, pfx=(entry, start, width))
         if fused_batch:
             self._prefill_fused(fused_slots, fused_batch)
+        if trickle and batch[0].adapter == 0 and self.slots[
+            slots_idx[0]
+        ].request is batch[0]:
+            # First sighting of a poolable prefix on a trickle
+            # admission: pool it from the admitted row's cache slice
+            # (one extra rare device call — the admission itself stayed
+            # fused). Bursts learn shared prefixes via
+            # _pfx_learn_from_burst instead; a longer-prefix upgrade
+            # over an existing hit rides the same store.
+            req = batch[0]
+            key = self._pfx_storable(req.prompt)
+            hit_len = None
+            for k in self._pfx_keys if self._pfx_pool is not None else []:
+                if (
+                    k is not None
+                    and self._lcp(k, np.asarray(
+                        req.prompt[: self._pfx_max], np.int32
+                    ), len(k)) == len(k)
+                ):
+                    hit_len = max(hit_len or 0, len(k))
+            if key is not None and (hit_len is None or hit_len < len(key)):
+                slot = slots_idx[0]
+                self._pfx_commit(key, lambda entry: self._pfx_store_slot(
+                    self._pfx_pool, self.cache, jnp.int32(slot),
+                    jnp.int32(entry), jnp.int32(len(key)),
+                ))
+        dt = (time.perf_counter() - t0) * 1000.0
+        self.timing["admit_ms"] += dt
+        self.timing["admit_rounds"] += 1
+        self._admit_ema_ms = (
+            0.7 * self._admit_ema_ms + 0.3 * dt / max(1, len(batch))
+        )
+
+    def _admit_chunked_group(
+        self,
+        rows: list[tuple[int, _Request]],
+        pfx: Optional[tuple[int, int, int]] = None,
+    ) -> None:
+        """ONE fused device call admitting `rows` (slot, request)
+        pairs. pfx=(entry, start, width): every row reuses pool entry
+        KV up to `start` and prefills one [B, 1, width] suffix step;
+        otherwise full prompts run the [B, T, prefill_chunk] grid from
+        position 0 (rows shorter than the deepest prompt pad with
+        no-op chunks)."""
+        b = len(self.slots)
+        if pfx is None:
+            c = min(self.cfg.prefill_chunk, self.max_seq)
+            n_max = max(len(req.prompt) for _, req in rows)
+            t_steps = max(1, -(-n_max // c))
+            start = 0
+        else:
+            entry, start, c = pfx
+            t_steps = 1
+        tokens = np.zeros((b, t_steps, c), np.int32)
+        true_len = np.zeros((b,), np.int32)
+        valid = np.zeros((b,), bool)
+        seeds = np.zeros((b,), np.uint32)
+        temps = np.zeros((b,), np.float32)
+        ks = np.zeros((b,), np.int32)
+        ps = np.ones((b,), np.float32)
+        adapters = np.zeros((b,), np.int32)
+        for sl, req in rows:
+            piece = np.asarray(req.prompt[start:], np.int32)
+            tokens[sl].reshape(-1)[: len(piece)] = piece
+            true_len[sl] = len(req.prompt)
+            valid[sl] = True
+            seeds[sl] = req.seed & 0xFFFFFFFF
+            temps[sl] = req.sampling.temperature
+            ks[sl] = req.sampling.top_k
+            ps[sl] = req.sampling.top_p
+            adapters[sl] = req.adapter
+        if pfx is not None:
+            self.prefix_hits += len(rows)
+        self._cache_at_risk = True
+        if pfx is None:
+            first, self.cache = self._admit_chunked(
+                self.engine.params, jnp.asarray(tokens),
+                jnp.asarray(true_len), self.cache, jnp.asarray(valid),
+                jnp.asarray(seeds), jnp.asarray(temps), jnp.asarray(ks),
+                jnp.asarray(ps), jnp.asarray(adapters),
+            )
+        else:
+            first, self.cache = self._admit_chunked_pfx(
+                self.engine.params, jnp.asarray(tokens),
+                jnp.asarray(true_len), self.cache, jnp.asarray(valid),
+                jnp.asarray(seeds), jnp.asarray(temps), jnp.asarray(ks),
+                jnp.asarray(ps), jnp.asarray(adapters),
+                self._pfx_pool, jnp.int32(entry), jnp.int32(start),
+            )
+        # Materialize BEFORE clearing the at-risk flag (async-dispatch
+        # failure surfacing — same contract as _prefill_fused).
+        first = np.asarray(first)
+        self._cache_at_risk = False
+        for sl, req in rows:
+            self._activate_slot(sl, req, int(first[sl]))
 
     def _prefill_fused(
         self, slots_idx: list[int], batch: list[_Request]
@@ -1103,6 +1468,7 @@ class ContinuousBatcher:
             self._tick_collect_one()
 
     def _tick_dispatch(self) -> None:
+        t0 = time.perf_counter()
         step0 = self.step_counter
         self.step_counter += self._steps_per_tick
         active = np.array([s.active for s in self.slots], bool)
@@ -1127,14 +1493,19 @@ class ContinuousBatcher:
         # N+1's junk row for the old request is collected.
         owners = [s.request if s.active else None for s in self.slots]
         self._inflight.append((toks, owners))
+        self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
+        self.timing["ticks"] += 1
 
     def _tick_collect_one(self) -> None:
         """Pull the oldest in-flight tick's tokens to the host and emit
         them. Rows whose owner no longer holds the slot (finished — and
         possibly re-admitted — since dispatch) are dropped: their
         tokens are the junk a parked slot keeps sampling."""
+        t0 = time.perf_counter()
         toks_dev, owners = self._inflight.popleft()
         toks = np.asarray(toks_dev)  # [B, steps_per_tick]
+        self.timing["tick_collect_ms"] += (time.perf_counter() - t0) * 1000.0
+        self.timing["collects"] += 1
         for i, request in enumerate(owners):
             if request is None:
                 continue
@@ -1173,6 +1544,10 @@ class ContinuousBatcher:
             # must not count the slot as still active.
             slot.active = False
             slot.request = None
+            self._lat_records.append((
+                request.queue_ms,
+                (time.perf_counter() - request.t_admit) * 1000.0,
+            ))
             # Freeze the row so it stops influencing shared state
             # (cache row stays, masked by length on reuse).
             self.temps[slot_idx] = 0.0

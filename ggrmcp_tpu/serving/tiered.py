@@ -100,11 +100,21 @@ class TieredBatcher:
         return sum(t.cache_bytes() for t in self.tiers)
 
     def stats(self) -> dict:
-        """Aggregated ServingStats across tiers."""
-        per_tier = [t.stats() for t in self.tiers]
+        """Aggregated ServingStats across tiers: counters sum;
+        queue/service percentiles are computed ONCE over the
+        concatenated per-tier latency records (summing a p50 is
+        meaningless, and per-tier percentile sorts would be wasted
+        work on every scrape)."""
+        per_tier = [t.counter_stats() for t in self.tiers]
+        records: list = []
+        for t in self.tiers:
+            records.extend(t.lat_snapshot())
         return {
-            key: sum(s[key] for s in per_tier)
-            for key in per_tier[0]
+            **{
+                key: sum(s[key] for s in per_tier)
+                for key in per_tier[0]
+            },
+            **ContinuousBatcher.lat_percentiles(records),
         }
 
     # Prefix-pool counters aggregate across tiers (each tier owns its

@@ -69,4 +69,47 @@ ECHOED=$(curl -s -D- -o /dev/null -H "Mcp-Session-Id: ${SID}" "${BASE}/" \
 echo "== /metrics"
 curl -sf "${BASE}/metrics" | grep -q 'gateway_tool_calls_total' || fail "prometheus metrics missing"
 
+# ---------------------------------------------------------------------
+# Real-weights + real-tokenizer stage (round-4 verdict #4): a genuine
+# HF checkpoint (transformers save_pretrained + a trained byte-level
+# BPE tokenizer.json) served via --tpu colaunch; the decoded text on
+# the wire must round-trip through the real tokenizer.
+# ---------------------------------------------------------------------
+CK_DIR="${CK_DIR:-/tmp/ggrmcp-e2e-hf-ck}"
+HF_HTTP_PORT="${HF_HTTP_PORT:-56063}"
+HF_BASE="http://localhost:${HF_HTTP_PORT}"
+
+echo "== building tiny real HF checkpoint (cached at ${CK_DIR})"
+[ -f "${CK_DIR}/model.safetensors" ] && [ -f "${CK_DIR}/tokenizer.json" ] \
+  || python scripts/make_tiny_hf_checkpoint.py --out "${CK_DIR}" \
+  || fail "checkpoint build"
+
+echo "== starting gateway --tpu with real checkpoint on :${HF_HTTP_PORT}"
+JAX_PLATFORMS="${E2E_JAX_PLATFORM:-cpu}" python -m ggrmcp_tpu gateway --tpu \
+  --hf-checkpoint "${CK_DIR}" --tokenizer "${CK_DIR}/tokenizer.json" \
+  --http-port "${HF_HTTP_PORT}" --dev &
+PIDS+=($!)
+for _ in $(seq 1 120); do
+  curl -sf "${HF_BASE}/health" >/dev/null 2>&1 && break
+  sleep 1
+done
+
+echo "== real-checkpoint generate (text round-trip)"
+GEN=$(curl -sf -X POST "${HF_BASE}/" -H 'Content-Type: application/json' \
+  -d '{"jsonrpc":"2.0","method":"tools/call","id":10,"params":{"name":"ggrmcp_tpu_generateservice_generate","arguments":{"prompt":"the quick brown fox jumps over the lazy dog","maxNewTokens":6,"returnTokens":true}}}')
+GEN="$GEN" CK_DIR="${CK_DIR}" python - <<'PYEOF' || fail "real-checkpoint round-trip: $GEN"
+import json, os, sys
+data = json.loads(os.environ["GEN"])
+assert "error" not in data, data
+payload = json.loads(data["result"]["content"][0]["text"])
+from tokenizers import Tokenizer
+tok = Tokenizer.from_file(os.path.join(os.environ["CK_DIR"], "tokenizer.json"))
+ids = payload["tokenIds"]
+assert 0 < len(ids) <= 6, payload
+assert payload.get("text", "") == tok.decode(ids), payload
+# BPE tokens, not bytes: BOS + trained-merge count
+assert payload["promptTokens"] == 1 + len(tok.encode("the quick brown fox jumps over the lazy dog").ids), payload
+print("real-checkpoint round-trip OK:", repr(payload.get("text", "")))
+PYEOF
+
 echo "ALL E2E SMOKE CHECKS PASSED"

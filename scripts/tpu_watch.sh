@@ -187,6 +187,33 @@ stage_8b_t16() {
   have_bench bench_tpu_8b_t16.json
 }
 
+# Deep batch (round-5 verdict lever 1b): 64 sessions over 64 decode
+# slots — the BASELINE.md 64-session saturation shape. With fused
+# 16-step ticks this is 1024 generated tokens per device round-trip;
+# on an RTT-bound tunnel throughput should scale near-linearly with
+# the slot count until the chip's weight-bandwidth term shows up.
+stage_1b_s64() {
+  note "stage llama-1b int8 s64: start"
+  GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_KV=int8 \
+    GGRMCP_BENCH_SESSIONS=64 GGRMCP_BENCH_CALLS=640 \
+    GGRMCP_BENCH_HEADLINE_ONLY=1 GGRMCP_BENCH_BUDGET_S=900 \
+    timeout 1000 python bench.py 9>&- \
+    > "$ART/bench_tpu_int8_s64.json" 2> "$ART/bench_tpu_int8_s64.err"
+  note "stage llama-1b int8 s64: rc=$? on_chip=$(have_bench bench_tpu_int8_s64.json && echo yes || echo no)"
+  have_bench bench_tpu_int8_s64.json
+}
+
+stage_8b_s64() {
+  note "stage llama3-8b int8 s64: start"
+  GGRMCP_BENCH_MODEL=llama3-8b GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_KV=int8 \
+    GGRMCP_BENCH_SYNTH=1 GGRMCP_BENCH_SESSIONS=64 GGRMCP_BENCH_CALLS=640 \
+    GGRMCP_BENCH_HEADLINE_ONLY=1 GGRMCP_BENCH_BUDGET_S=1500 \
+    timeout 1600 python bench.py 9>&- \
+    > "$ART/bench_tpu_8b_s64.json" 2> "$ART/bench_tpu_8b_s64.err"
+  note "stage llama3-8b int8 s64: rc=$? on_chip=$(have_bench bench_tpu_8b_s64.json && echo yes || echo no)"
+  have_bench bench_tpu_8b_s64.json
+}
+
 # Pipeline A/B: same knobs as the banked base int8 stage but with the
 # pipelined tick dispatch forced OFF — the delta against
 # bench_tpu_int8.json (pipeline auto=on over the tunnel) measures what
@@ -229,6 +256,8 @@ all_done() {
     && have_bench bench_tpu_8b.json \
     && have_bench bench_tpu_int8_t16.json \
     && have_bench bench_tpu_8b_t16.json \
+    && have_bench bench_tpu_int8_s64.json \
+    && have_bench bench_tpu_8b_s64.json \
     && have_bench bench_tpu_int8_nopipe.json \
     && [ -f "$ART/.rebanked_1b" ]
 }
@@ -243,6 +272,8 @@ run_ladder() {
   # fresh full-phase flagship capture (which feeds BENCH_r{N}) is
   # worth more than the tuning points.
   [ -f "$ART/.rebanked_1b" ] || stage_rebank_1b || probe || return 1
+  have_bench bench_tpu_int8_s64.json || stage_1b_s64 || probe || return 1
+  have_bench bench_tpu_8b_s64.json   || stage_8b_s64 || probe || return 1
   have_bench bench_tpu_int8_t16.json || stage_1b_t16 || probe || return 1
   have_bench bench_tpu_8b_t16.json   || stage_8b_t16 || probe || return 1
   have_bench bench_tpu_int8_nopipe.json || stage_1b_nopipe || probe || return 1
