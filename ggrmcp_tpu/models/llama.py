@@ -73,14 +73,23 @@ CONFIGS: dict[str, LlamaConfig] = {
         num_heads=8, num_kv_heads=4, head_dim=32, ffn_dim=704,
         max_seq_len=1024, dtype="float32",
     ),
-    # 8k context (theta raised proportionally): the serving registry's
-    # mid-size config must cover the ≥4096-token long-prompt tier with
-    # generation headroom — at 4096 the long phase clamps to 4016
-    # prompt tokens (bench.py long_prompt_target).
+    # Registry entries are STABLE once published — numerics for a
+    # checkpoint saved/served under a name must never silently change
+    # (round-4 advisory). Context-extended variants get NEW names (the
+    # tiny-llama-8k pattern below).
     "llama-1b": LlamaConfig(
         name="llama-1b", vocab_size=32000, hidden_dim=2048, num_layers=16,
         num_heads=32, num_kv_heads=8, head_dim=64, ffn_dim=5632,
-        max_seq_len=8192, rope_theta=32000.0,
+        max_seq_len=4096, rope_theta=10000.0,
+    ),
+    # Long-context variant: 2x context with rope_theta raised to keep
+    # the longest-period frequencies useful at 8k positions (NTK-style
+    # extension; 3.2x theta for 2x context is deliberately
+    # conservative, not proportional).
+    "llama-1b-8k": LlamaConfig(
+        name="llama-1b-8k", vocab_size=32000, hidden_dim=2048,
+        num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+        ffn_dim=5632, max_seq_len=8192, rope_theta=32000.0,
     ),
     "llama3-8b": LlamaConfig(
         name="llama3-8b", vocab_size=128256, hidden_dim=4096, num_layers=32,

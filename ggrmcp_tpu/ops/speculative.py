@@ -1,18 +1,30 @@
-"""Greedy speculative decoding: a small draft model proposes gamma
-tokens per round; the target model verifies ALL of them in ONE parallel
-forward — the TPU-shaped trade: gamma sequential target decode steps
-(small, latency-bound matmuls) become one (gamma+1)-token forward that
-keeps the MXU busy, plus a cheap draft loop.
+"""Speculative decoding: a small draft model proposes gamma tokens per
+round; the target model verifies ALL of them in ONE parallel forward —
+the TPU-shaped trade: gamma sequential target decode steps (small,
+latency-bound matmuls) become one (gamma+1)-token forward that keeps
+the MXU busy, plus a cheap draft loop.
 
-Acceptance is exact-match (greedy): a proposed token is accepted iff
-the target's argmax at that position equals it, so the emitted sequence
-is IDENTICAL to target-only greedy decoding regardless of draft quality
-— a correctness invariant the tests pin down. The whole generation is
-one jitted program: an outer `lax.while_loop` over verify rounds, the
-draft's proposal loop as an inner `lax.scan`, KV caches as fixed-size
-carries with explicit per-row length accounting (rollback on rejection
-= set the length counter; stale KV beyond it is masked by the causal
-attention window).
+Two per-row acceptance modes share one program:
+
+- Greedy (temperature 0): exact-match — a proposed token is accepted
+  iff the target's argmax at that position equals it, so the emitted
+  sequence is IDENTICAL to target-only greedy decoding regardless of
+  draft quality (a correctness invariant the tests pin down).
+- Sampled (temperature > 0): standard rejection sampling (Leviathan et
+  al. 2023; Chen et al. 2023) — the draft SAMPLES proposal x from its
+  temperature-scaled distribution q, the proposal is accepted with
+  probability min(1, p(x)/q(x)) against the target's distribution p,
+  and on the first rejection the correction token is sampled from the
+  residual normalize(max(p - q, 0)). The emitted tokens are then
+  distributed EXACTLY as target-only sampling (lossless in
+  distribution, not bitwise — tests/test_speculative.py pins both the
+  self-draft acceptance invariant and the output distribution).
+
+The whole generation is one jitted program: an outer `lax.while_loop`
+over verify rounds, the draft's proposal loop as an inner `lax.scan`,
+KV caches as fixed-size carries with explicit per-row length
+accounting (rollback on rejection = set the length counter; stale KV
+beyond it is masked by the causal attention window).
 
 No reference analogue (the Go gateway executes no models); this is a
 serving-plane throughput component like ops/quant.py.
@@ -50,12 +62,17 @@ def speculative_generate(
     use_flash=None,  # threaded to forward (see engine flash policy)
     flash_mesh=None,
     kv_dtype: str = "",  # "" model dtype | "int8" quantized KV caches
+    temperature=None,  # [B] float; None → all-greedy program (no RNG ops)
+    seeds=None,  # [B] per-row PRNG seeds (required when temperature given)
 ) -> SpecResult:
-    """Generate up to `max_new` tokens per row, greedy, speculative.
+    """Generate up to `max_new` tokens per row, speculative.
 
     `max_new_budget` is static (sizes the output buffer — bucket it to
     bound compilations); `max_new` is traced, so different request caps
     reuse the same compiled program and decoding stops at the cap.
+    `temperature=None` compiles the pure-greedy program; a [B] array
+    enables per-row rejection sampling (rows with temperature 0 stay
+    exact-match greedy inside the same program — see module docstring).
 
     The family modules supply the serving `forward(params, cfg, tokens,
     cache) -> (logits, cache)` contract (models/llama.py). Dense
@@ -68,6 +85,30 @@ def speculative_generate(
     if max_new is None:
         max_new = max_new_budget
     max_new = jnp.minimum(jnp.int32(max_new), max_new_budget)
+    sampled_mode = temperature is not None
+    if sampled_mode:
+        temperature = jnp.asarray(temperature, jnp.float32)
+        is_sampled = temperature > 0.0  # [B] — 0 rows stay greedy
+        safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+        row_keys = jax.vmap(jax.random.PRNGKey)(
+            jnp.asarray(seeds, jnp.uint32).astype(jnp.int32)
+        )
+
+        def _draw(logits, keys):
+            """Per-row: temperature sample (Gumbel trick) where
+            sampled, argmax where greedy."""
+            g = jax.vmap(
+                lambda k: jax.random.gumbel(k, (logits.shape[-1],))
+            )(keys)
+            samp = jnp.argmax(logits / safe_t + g, axis=-1)
+            return jnp.where(
+                is_sampled, samp, jnp.argmax(logits, axis=-1)
+            ).astype(jnp.int32)
+
+        def _fold(keys, tag):
+            return jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                keys, tag
+            )
     budget = s + max_new_budget + gamma + 2  # verify may overshoot
     # Per-position int8 quantization is write-order independent, so
     # the verify re-reads see exactly the cache the draft rounds wrote
@@ -83,10 +124,13 @@ def speculative_generate(
         draft_params, draft_cfg, tokens, dcache, use_flash=use_flash, flash_mesh=flash_mesh
     )
     last_idx = jnp.maximum(true_len - 1, 0)
-    first = jnp.argmax(
-        jnp.take_along_axis(tlogits, last_idx[:, None, None], axis=1)[:, 0],
-        axis=-1,
-    ).astype(jnp.int32)  # [B] — first generated token t0
+    last_logits = jnp.take_along_axis(
+        tlogits, last_idx[:, None, None], axis=1
+    )[:, 0]  # [B, V]
+    if sampled_mode:
+        first = _draw(last_logits, _fold(row_keys, 0))
+    else:
+        first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
 
     # Roll both caches back to the true prompt length (prefill advanced
     # them by the padded S). The draft additionally steps back one more:
@@ -120,24 +164,51 @@ def speculative_generate(
             draft_params, draft_cfg, two, dcache, use_flash=use_flash,
             flash_mesh=flash_mesh,
         )
-        d1 = jnp.argmax(dlogits[:, -1], axis=-1).astype(jnp.int32)
+        if sampled_mode:
+            # Per-round, per-row keys: row seed ⊕ round ⊕ position tag
+            # (tags 1..gamma draft draws, 700 uniforms, 900 residual).
+            rk = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                row_keys, rounds + 1
+            )
+            d1 = _draw(dlogits[:, -1], _fold(rk, 1))
+            q0 = jax.nn.log_softmax(dlogits[:, -1] / safe_t, axis=-1)
+        else:
+            d1 = jnp.argmax(dlogits[:, -1], axis=-1).astype(jnp.int32)
 
-        def draft_step(c, _):
+        def draft_step(c, pos):
             tok, dc = c
             lg, dc = draft_fam.forward(
                 draft_params, draft_cfg, tok[:, None], dc,
                 use_flash=use_flash, flash_mesh=flash_mesh,
             )
-            nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+            lgl = lg[:, -1]
+            if sampled_mode:
+                nxt = _draw(lgl, _fold(rk, 1 + pos))
+                return (nxt, dc), (nxt, lgl)
+            nxt = jnp.argmax(lgl, axis=-1).astype(jnp.int32)
+            # Greedy program: don't carry [gamma-1, B, V] logits the
+            # acceptance rule never reads.
             return (nxt, dc), nxt
 
         if gamma > 1:
-            (_, dcache2), rest = jax.lax.scan(
-                draft_step, (d1, dcache2), None, length=gamma - 1
+            (_, dcache2), ys = jax.lax.scan(
+                draft_step, (d1, dcache2), jnp.arange(1, gamma)
             )
+            rest, rest_lg = ys if sampled_mode else (ys, None)
             proposals = jnp.concatenate([d1[:, None], rest.T], axis=1)
+            if sampled_mode:
+                qlogp = jnp.moveaxis(
+                    jnp.concatenate([
+                        q0[None],
+                        jax.nn.log_softmax(
+                            rest_lg / safe_t[None], axis=-1
+                        ),
+                    ], axis=0), 0, 1,
+                )  # [B, gamma, V]
         else:
             proposals = d1[:, None]  # [B, gamma]
+            if sampled_mode:
+                qlogp = q0[:, None]  # [B, 1, V]
 
         # --- target verifies in ONE forward --------------------------
         verify_in = jnp.concatenate([cur[:, None], proposals], axis=1)
@@ -147,12 +218,53 @@ def speculative_generate(
         )
         greedy = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, gamma+1]
         # greedy[:, i] is the target's token AFTER verify_in[:, i]:
-        # proposal i (= proposals[:, i]) is accepted iff it equals
-        # greedy[:, i] and all earlier proposals were accepted.
-        match = proposals == greedy[:, :gamma]
+        # greedy rows accept proposal i (= proposals[:, i]) iff it
+        # equals greedy[:, i] and all earlier proposals were accepted;
+        # sampled rows accept with probability min(1, p(x)/q(x)).
+        if sampled_mode:
+            vlogp = jax.nn.log_softmax(
+                vlogits / safe_t[:, :, None], axis=-1
+            )  # [B, gamma+1, V]
+            u = jax.vmap(lambda k: jax.random.uniform(k, (gamma,)))(
+                _fold(rk, 700)
+            )
+            logp_x = jnp.take_along_axis(
+                vlogp[:, :gamma], proposals[:, :, None], axis=2
+            )[:, :, 0]
+            logq_x = jnp.take_along_axis(
+                qlogp, proposals[:, :, None], axis=2
+            )[:, :, 0]
+            match = jnp.where(
+                is_sampled[:, None],
+                jnp.log(u) < (logp_x - logq_x),
+                proposals == greedy[:, :gamma],
+            )
+        else:
+            match = proposals == greedy[:, :gamma]
         acc_mask = jnp.cumprod(match.astype(jnp.int32), axis=1)
         a = acc_mask.sum(axis=1)  # [B] in [0, gamma]
         correction = jnp.take_along_axis(greedy, a[:, None], axis=1)[:, 0]
+        if sampled_mode:
+            # Correction: residual distribution max(p - q, 0)/Z at the
+            # first rejected position; the bonus token after gamma
+            # acceptances samples p directly.
+            p_a = jnp.take_along_axis(
+                vlogp, a[:, None, None], axis=1
+            )[:, 0]  # [B, V] log p at the correction position
+            q_a = jnp.take_along_axis(
+                qlogp, jnp.clip(a, 0, gamma - 1)[:, None, None], axis=1
+            )[:, 0]
+            resid = jnp.maximum(jnp.exp(p_a) - jnp.exp(q_a), 0.0)
+            resid = jnp.where(
+                (a == gamma)[:, None], jnp.exp(p_a), resid
+            )
+            g2 = jax.vmap(
+                lambda k: jax.random.gumbel(k, (resid.shape[-1],))
+            )(_fold(rk, 900))
+            samp_corr = jnp.argmax(
+                jnp.log(resid + 1e-30) + g2, axis=-1
+            ).astype(jnp.int32)
+            correction = jnp.where(is_sampled, samp_corr, correction)
 
         # --- emit [d_1..d_a, correction] -----------------------------
         idx = jnp.arange(gamma + 1)[None, :]

@@ -214,6 +214,7 @@ class ContinuousBatcher:
             "tick_dispatch_ms": 0.0,
             "tick_collect_ms": 0.0,
             "admit_ms": 0.0,
+            "admit_ms_max": 0.0,  # worst single admission round
             "ticks": 0,
             "collects": 0,
             "admit_rounds": 0,
@@ -372,44 +373,46 @@ class ContinuousBatcher:
         return fl, mini
 
     def _chunked_finish(
-        self, cache, mini, valid, true_len, fl, seeds, temps, ks, ps
+        self, cache, mini, slots, true_len, fl, seeds, temps, ks, ps
     ):
-        """Merge the admission mini (full cache width) into the shared
-        cache at the valid rows and sample each row's first token —
-        the same row-select as _admit_full_impl, so no scatter
-        hazards."""
+        """Scatter the [R, S_max] admission mini into the shared cache
+        at `slots` (padding rows carry an out-of-range slot index and
+        are DROPPED by the scatter — real slots are distinct, so no
+        duplicate-index hazards) and sample each row's first token."""
         first = sample_dynamic(fl, seeds, jnp.int32(0), temps, ks, ps)
-        sel = valid[None, :, None, None, None]
 
-        def select(c_, m):
-            return jnp.where(sel, m.astype(c_.dtype), c_)
+        def put(c_, m):
+            return c_.at[:, slots].set(m.astype(c_.dtype), mode="drop")
 
-        k = quant.kv_map(select, cache.k, mini.k)
-        v = quant.kv_map(select, cache.v, mini.v)
-        lengths = jnp.where(valid, true_len, cache.length)
+        k = quant.kv_map(put, cache.k, mini.k)
+        v = quant.kv_map(put, cache.v, mini.v)
+        lengths = cache.length.at[slots].set(true_len, mode="drop")
         return first, llama_mod.KVCache(k=k, v=v, length=lengths)
 
     def _admit_chunked_impl(
-        self, params, tokens, true_len, cache, valid, seeds, temps, ks,
+        self, params, tokens, true_len, cache, slots, seeds, temps, ks,
         ps, adapters,
     ):
-        """Fused chunked admission (no prefix): the whole [B, T, C]
-        prefill grid + merge + first-token sample, ONE device call."""
-        b = tokens.shape[0]
-        mini = self._make_mini(b, self.max_seq)
+        """Fused chunked admission (no prefix): the whole [R, T, C]
+        prefill grid + merge + first-token sample, ONE device call.
+        R is the caller's bucketed group size — per-row work here is
+        the heavy case (long prompts), so a trickle admission must not
+        pay the full slot pool's compute."""
+        r = tokens.shape[0]
+        mini = self._make_mini(r, self.max_seq)
         fl, mini = self._chunked_scan(
             params, tokens, true_len, mini, adapters, jnp.int32(0)
         )
         return self._chunked_finish(
-            cache, mini, valid, true_len, fl, seeds, temps, ks, ps
+            cache, mini, slots, true_len, fl, seeds, temps, ks, ps
         )
 
     def _admit_chunked_pfx_impl(
-        self, params, tokens, true_len, cache, valid, seeds, temps, ks,
+        self, params, tokens, true_len, cache, slots, seeds, temps, ks,
         ps, adapters, pool, entry, start,
     ):
         """Fused prefix-reuse admission: pool entry `entry` seeds the
-        first `start` positions of EVERY row, then the [B, 1, W] suffix
+        first `start` positions of EVERY row, then the [R, 1, W] suffix
         grid runs from `start`. One device call admits a whole wave of
         same-preamble requests — the agentic arrival shape."""
         b = tokens.shape[0]
@@ -433,7 +436,7 @@ class ContinuousBatcher:
             params, tokens, true_len, mini, adapters, start
         )
         return self._chunked_finish(
-            cache, mini, valid, true_len, fl, seeds, temps, ks, ps
+            cache, mini, slots, true_len, fl, seeds, temps, ks, ps
         )
 
     def _tick_impl(
@@ -851,20 +854,41 @@ class ContinuousBatcher:
         # bench, send one long warmup request off the clock).
         b_rows = len(self.slots)
         zlenb = np.zeros((b_rows,), np.int32)
-        zvalid = np.zeros((b_rows,), bool)
+        # Out-of-range slot indices: the insert scatter drops every
+        # warmup row, leaving the cache untouched.
+        zslotb = np.full((b_rows,), b_rows, np.int32)
         zseedb = np.zeros((b_rows,), np.uint32)
         zfb = np.zeros((b_rows,), np.float32)
         zib = np.zeros((b_rows,), np.int32)
         ofb = np.ones((b_rows,), np.float32)
         c = min(self.cfg.prefill_chunk, self.max_seq)
         if self.cfg.prefill_chunk < self._fit_limit or self._ring:
-            _, self.cache = self._admit_chunked(
-                self.engine.params,
-                jnp.asarray(np.zeros((b_rows, 1, c), np.int32)),
-                jnp.asarray(zlenb), self.cache, jnp.asarray(zvalid),
-                jnp.asarray(zseedb), jnp.asarray(zfb), jnp.asarray(zib),
-                jnp.asarray(ofb), jnp.asarray(zib),
-            )
+            # Warm every reachable row bucket (R = 1, 2, 4 .. B) at
+            # T=1. Deeper T grids still compile on their first long
+            # prompt (warming the full R×T product would be quadratic
+            # in compile time) — callers that care send off-clock
+            # long warmup requests (the bench does), and the
+            # persistent compile cache keeps programs across runs.
+            r_buckets = []
+            r_bucket = 1
+            while r_bucket < len(self.slots):
+                r_buckets.append(r_bucket)
+                r_bucket *= 2
+            # Groups clamp to the pool size, so non-pow2 pools reach
+            # R = B itself (_admit_chunked_group's min(b, bucket)).
+            r_buckets.append(len(self.slots))
+            for r_bucket in r_buckets:
+                _, self.cache = self._admit_chunked(
+                    self.engine.params,
+                    jnp.asarray(np.zeros((r_bucket, 1, c), np.int32)),
+                    jnp.asarray(zlenb[:r_bucket]), self.cache,
+                    jnp.asarray(zslotb[:r_bucket]),
+                    jnp.asarray(zseedb[:r_bucket]),
+                    jnp.asarray(zfb[:r_bucket]),
+                    jnp.asarray(zib[:r_bucket]),
+                    jnp.asarray(ofb[:r_bucket]),
+                    jnp.asarray(zib[:r_bucket]),
+                )
         if self._pfx_pool is not None:
             # plen=0 and no host-side key: the warmup entry can never
             # match a lookup. Store programs first (mini from a plain
@@ -886,10 +910,13 @@ class ContinuousBatcher:
             # mid-request (minutes over a remote-compile TPU link).
             width = 32
             while width <= bucket_len(c, maximum=self.max_seq):
+                # Wave shape (R=B) — the agentic arrival pattern the
+                # pool exists for; trickle hits (R=1) compile on first
+                # use.
                 _, self.cache = self._admit_chunked_pfx(
                     self.engine.params,
                     jnp.asarray(np.zeros((b_rows, 1, width), np.int32)),
-                    jnp.asarray(zlenb), self.cache, jnp.asarray(zvalid),
+                    jnp.asarray(zlenb), self.cache, jnp.asarray(zslotb),
                     jnp.asarray(zseedb), jnp.asarray(zfb),
                     jnp.asarray(zib), jnp.asarray(ofb), jnp.asarray(zib),
                     self._pfx_pool, jnp.int32(0), jnp.int32(0),
@@ -1070,6 +1097,10 @@ class ContinuousBatcher:
             "tick_dispatch_ms": round(t["tick_dispatch_ms"], 2),
             "tick_collect_ms": round(t["tick_collect_ms"], 2),
             "admit_ms": round(t["admit_ms"], 2),
+            # Worst single admission round — what the p50_budget_ms
+            # cap bounds. NOT summable: the tiered facade takes the
+            # max across tiers.
+            "admit_ms_max": round(t["admit_ms_max"], 2),
         }
 
     # -- the loop -----------------------------------------------------------
@@ -1323,6 +1354,7 @@ class ContinuousBatcher:
                 ))
         dt = (time.perf_counter() - t0) * 1000.0
         self.timing["admit_ms"] += dt
+        self.timing["admit_ms_max"] = max(self.timing["admit_ms_max"], dt)
         self.timing["admit_rounds"] += 1
         self._admit_ema_ms = (
             0.7 * self._admit_ema_ms + 0.3 * dt / max(1, len(batch))
@@ -1335,51 +1367,61 @@ class ContinuousBatcher:
     ) -> None:
         """ONE fused device call admitting `rows` (slot, request)
         pairs. pfx=(entry, start, width): every row reuses pool entry
-        KV up to `start` and prefills one [B, 1, width] suffix step;
-        otherwise full prompts run the [B, T, prefill_chunk] grid from
+        KV up to `start` and prefills one [R, 1, width] suffix step;
+        otherwise full prompts run the [R, T, prefill_chunk] grid from
         position 0 (rows shorter than the deepest prompt pad with
-        no-op chunks)."""
+        no-op chunks).
+
+        Row-count bucketing: long-prompt groups compile per power-of-2
+        R (a trickle long admission must not pay the full slot pool's
+        prefill compute — group-of-1 at full B measured 4× the serial
+        cost on CPU). Prefix groups are cheap per row (one short suffix
+        step), so they use only R=1 (trickle) or R=B (wave) to keep the
+        warmup compile ladder small. Padding rows carry slot index B
+        (out of range → dropped by the insert scatter)."""
         b = len(self.slots)
         if pfx is None:
             c = min(self.cfg.prefill_chunk, self.max_seq)
             n_max = max(len(req.prompt) for _, req in rows)
             t_steps = max(1, -(-n_max // c))
             start = 0
+            r = min(b, bucket_len(len(rows), minimum=1))
         else:
             entry, start, c = pfx
             t_steps = 1
-        tokens = np.zeros((b, t_steps, c), np.int32)
-        true_len = np.zeros((b,), np.int32)
-        valid = np.zeros((b,), bool)
-        seeds = np.zeros((b,), np.uint32)
-        temps = np.zeros((b,), np.float32)
-        ks = np.zeros((b,), np.int32)
-        ps = np.ones((b,), np.float32)
-        adapters = np.zeros((b,), np.int32)
-        for sl, req in rows:
+            r = 1 if len(rows) == 1 else b
+        tokens = np.zeros((r, t_steps, c), np.int32)
+        true_len = np.zeros((r,), np.int32)
+        slots_arr = np.full((r,), b, np.int32)  # pad = out of range
+        seeds = np.zeros((r,), np.uint32)
+        temps = np.zeros((r,), np.float32)
+        ks = np.zeros((r,), np.int32)
+        ps = np.ones((r,), np.float32)
+        adapters = np.zeros((r,), np.int32)
+        for j, (sl, req) in enumerate(rows):
             piece = np.asarray(req.prompt[start:], np.int32)
-            tokens[sl].reshape(-1)[: len(piece)] = piece
-            true_len[sl] = len(req.prompt)
-            valid[sl] = True
-            seeds[sl] = req.seed & 0xFFFFFFFF
-            temps[sl] = req.sampling.temperature
-            ks[sl] = req.sampling.top_k
-            ps[sl] = req.sampling.top_p
-            adapters[sl] = req.adapter
+            tokens[j].reshape(-1)[: len(piece)] = piece
+            true_len[j] = len(req.prompt)
+            slots_arr[j] = sl
+            seeds[j] = req.seed & 0xFFFFFFFF
+            temps[j] = req.sampling.temperature
+            ks[j] = req.sampling.top_k
+            ps[j] = req.sampling.top_p
+            adapters[j] = req.adapter
         if pfx is not None:
             self.prefix_hits += len(rows)
         self._cache_at_risk = True
         if pfx is None:
             first, self.cache = self._admit_chunked(
                 self.engine.params, jnp.asarray(tokens),
-                jnp.asarray(true_len), self.cache, jnp.asarray(valid),
+                jnp.asarray(true_len), self.cache, jnp.asarray(slots_arr),
                 jnp.asarray(seeds), jnp.asarray(temps), jnp.asarray(ks),
                 jnp.asarray(ps), jnp.asarray(adapters),
             )
         else:
             first, self.cache = self._admit_chunked_pfx(
                 self.engine.params, jnp.asarray(tokens),
-                jnp.asarray(true_len), self.cache, jnp.asarray(valid),
+                jnp.asarray(true_len), self.cache, jnp.asarray(slots_arr),
                 jnp.asarray(seeds), jnp.asarray(temps), jnp.asarray(ks),
                 jnp.asarray(ps), jnp.asarray(adapters),
                 self._pfx_pool, jnp.int32(entry), jnp.int32(start),
@@ -1388,8 +1430,8 @@ class ContinuousBatcher:
         # failure surfacing — same contract as _prefill_fused).
         first = np.asarray(first)
         self._cache_at_risk = False
-        for sl, req in rows:
-            self._activate_slot(sl, req, int(first[sl]))
+        for j, (sl, req) in enumerate(rows):
+            self._activate_slot(sl, req, int(first[j]))
 
     def _prefill_fused(
         self, slots_idx: list[int], batch: list[_Request]

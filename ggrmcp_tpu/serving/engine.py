@@ -530,7 +530,7 @@ class GenerationEngine:
 
     def _spec_impl(
         self, params, draft_params, tokens, true_len, max_new_budget: int,
-        max_new, eos_id,
+        max_new, eos_id, temperature=None, seeds=None,
     ):
         from ggrmcp_tpu.ops.speculative import speculative_generate
 
@@ -540,7 +540,7 @@ class GenerationEngine:
             tokens, true_len, max_new_budget,
             self.serving.speculative_gamma, eos_id, max_new=max_new,
             use_flash=self.use_flash, flash_mesh=self.flash_mesh,
-            kv_dtype=self.kv_dtype,
+            kv_dtype=self.kv_dtype, temperature=temperature, seeds=seeds,
         )
 
     def warmup_speculative(self, max_new_budget: int = 64) -> None:
@@ -848,13 +848,18 @@ class GenerationEngine:
         prompts: list[list[int]],
         max_new_tokens: int = 128,
         eos_id: int = 2,
+        temperatures: Optional[list[float]] = None,
+        seeds: Optional[list[int]] = None,
     ) -> tuple[list[list[int]], list[str], dict]:
-        """Greedy speculative batch generation (requires a configured
-        draft model). Output is identical to greedy `generate`; returns
-        (token lists, finish reasons, stats with acceptance rate). The
-        decode budget is bucketed (static buffer) while the requested
-        cap rides as a traced arg, so request-to-request max_new
-        changes reuse the compiled program."""
+        """Speculative batch generation (requires a configured draft
+        model). With `temperatures=None` the output is identical to
+        greedy `generate`; a per-row temperature list enables rejection
+        sampling (output distributed exactly as plain sampling —
+        ops/speculative.py). Returns (token lists, finish reasons,
+        stats with acceptance rate). The decode budget is bucketed
+        (static buffer) while the requested cap rides as a traced arg,
+        so request-to-request max_new changes reuse the compiled
+        program."""
         if self.draft_fam is None:
             raise RuntimeError("speculative decoding not configured")
         limit = min(self.cfg.max_seq_len, self.draft_cfg.max_seq_len)
@@ -862,11 +867,21 @@ class GenerationEngine:
             prompts, max_new_tokens, limit
         )
         budget = bucket_len(max_new_tokens, minimum=8, maximum=limit)
+        temps = seed_arr = None
+        if temperatures is not None:
+            temps = jnp.asarray(
+                np.asarray(temperatures, np.float32)
+            )
+            seed_arr = jnp.asarray(np.asarray(
+                [(s or 0) & 0xFFFFFFFF for s in (seeds or [0] * len(prompts))],
+                np.uint32,
+            ))
         with self.mesh:
             res = self._spec_fn(
                 self.params, self.draft_params,
                 jnp.asarray(tokens), jnp.asarray(true_len),
                 budget, jnp.int32(max_new_tokens), jnp.int32(eos_id),
+                temps, seed_arr,
             )
         results, reasons = self._decode_outputs(
             np.asarray(res.tokens), np.asarray(res.out_len), eos_id

@@ -195,9 +195,18 @@ class Sidecar:
         finish = "length"
         sampling = self._sampling(request)
         adapter = await self._resolve_adapter(request, context)
+        # Draft-assisted path: greedy requests (lossless, bitwise) and
+        # plain temperature sampling (rejection sampling — lossless in
+        # distribution, ops/speculative.py). top-k/top-p filtering is
+        # not implemented in the rejection sampler, so those requests
+        # take the continuous batcher. Adapters can't reach this gate:
+        # lora + speculative_draft is rejected at engine init
+        # (engine._init_lora), so a draft-configured sidecar resolves
+        # every request to the base model.
         speculative = (
             self.generation.draft_fam is not None
-            and sampling.temperature <= 0.0
+            and sampling.top_k <= 0
+            and sampling.top_p >= 1.0
         )
         with tracing.tracer.span(
             "sidecar.generate",
@@ -214,7 +223,9 @@ class Sidecar:
                 # one private program at a time.
                 try:
                     token_ids, finish, stats = await self.spec_batcher.submit(
-                        prompt, max_new
+                        prompt, max_new,
+                        temperature=max(0.0, sampling.temperature),
+                        seed=seed,
                     )
                     span.set(**stats)
                 except Exception:
@@ -312,6 +323,8 @@ class Sidecar:
         if self.spec_batcher is not None:
             stats["speculative_calls"] = self.spec_batcher.calls
             stats["speculative_requests"] = self.spec_batcher.requests
+            stats["speculative_drafted"] = self.spec_batcher.drafted
+            stats["speculative_accepted"] = self.spec_batcher.accepted
             stats["queued_requests"] = (
                 stats.get("queued_requests", 0)
                 + self.spec_batcher.queue.qsize()

@@ -43,9 +43,13 @@ class SpeculativeBatcher:
         self._task: Optional[asyncio.Task] = None
         self._stopping = False
         # Introspection: how many device calls served how many requests
-        # (tests assert batching actually happens; /stats reports it).
+        # (tests assert batching actually happens; /stats reports it),
+        # plus cumulative draft/accept counts — drafted/accepted is the
+        # realized acceptance rate exported via ServingStats.
         self.calls = 0
         self.requests = 0
+        self.drafted = 0
+        self.accepted = 0
 
     def start(self) -> None:
         if self._task is None:
@@ -66,7 +70,7 @@ class SpeculativeBatcher:
         # grace (in-flight batches fail their futures in _run_batch).
         while not self.queue.empty():
             try:
-                _, _, fut = self.queue.get_nowait()
+                *_, fut = self.queue.get_nowait()
             except asyncio.QueueEmpty:
                 break
             if not fut.done():
@@ -75,12 +79,16 @@ class SpeculativeBatcher:
                 )
 
     async def submit(
-        self, prompt: list[int], max_new: int
+        self, prompt: list[int], max_new: int,
+        temperature: float = 0.0, seed: int = 0,
     ) -> tuple[list[int], str, dict]:
-        """Returns (token_ids, finish_reason, stats) — identical output
-        to a solo `generate_speculative([prompt], max_new)` call."""
+        """Returns (token_ids, finish_reason, stats). Greedy rows
+        (temperature 0) produce output identical to a solo
+        `generate_speculative([prompt], max_new)` call; sampled rows
+        are rejection-sampled (distribution-lossless, seeded per
+        row)."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self.queue.put((prompt, max_new, fut))
+        await self.queue.put((prompt, max_new, float(temperature), seed, fut))
         return await fut
 
     async def _loop(self) -> None:
@@ -108,7 +116,7 @@ class SpeculativeBatcher:
                 # into this in-progress batch are in neither the queue
                 # nor _run_batch — fail them here or their submit()
                 # callers hang past shutdown grace.
-                for _, _, fut in batch:
+                for *_, fut in batch:
                     if not fut.done():
                         fut.set_exception(
                             RuntimeError("speculative batcher stopped")
@@ -128,7 +136,7 @@ class SpeculativeBatcher:
         # requests into their own single-row calls (own cap → solo
         # semantics, exactly).
         limit = self._fit_limit()
-        budget = max(cap for _, cap, _ in batch)
+        budget = max(cap for _, cap, _, _, _ in batch)
         safe = [b for b in batch if len(b[0]) + budget + 1 <= limit]
         unsafe = [b for b in batch if len(b[0]) + budget + 1 > limit]
         if unsafe and len(batch) > 1:
@@ -139,15 +147,23 @@ class SpeculativeBatcher:
             batch = safe
         prompts = [b[0] for b in batch]
         caps = [b[1] for b in batch]
-        futs = [b[2] for b in batch]
+        temps = [b[2] for b in batch]
+        seeds = [b[3] for b in batch]
+        futs = [b[4] for b in batch]
         budget = max(caps)
+        # All-greedy batches keep the RNG-free program (and its bitwise
+        # solo-run identity); any sampled row switches the batch to the
+        # per-row rejection-sampling program (greedy rows inside it
+        # still decode exact-match greedy).
+        temperatures = temps if any(t > 0 for t in temps) else None
         self.calls += 1
         self.requests += len(batch)
         try:
             outs, reasons, stats = await loop.run_in_executor(
                 None,
                 lambda: self.engine.generate_speculative(
-                    prompts, budget, eos_id=self.eos_id
+                    prompts, budget, eos_id=self.eos_id,
+                    temperatures=temperatures, seeds=seeds,
                 ),
             )
         except BaseException as exc:
@@ -165,6 +181,8 @@ class SpeculativeBatcher:
         # Rounds/drafted/accepted are BATCH aggregates — tag them so a
         # per-request trace span is interpretable.
         stats = {**stats, "batched_requests": len(batch)}
+        self.drafted += stats.get("drafted", 0)
+        self.accepted += stats.get("accepted", 0)
         for ids, reason, cap, fut in zip(outs, reasons, caps, futs):
             if len(ids) > cap:
                 # Greedy rows are deterministic: the first `cap` tokens

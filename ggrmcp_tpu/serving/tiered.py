@@ -111,7 +111,11 @@ class TieredBatcher:
             records.extend(t.lat_snapshot())
         return {
             **{
-                key: sum(s[key] for s in per_tier)
+                key: (
+                    max(s[key] for s in per_tier)
+                    if key == "admit_ms_max"  # a max, not a sum
+                    else sum(s[key] for s in per_tier)
+                )
                 for key in per_tier[0]
             },
             **ContinuousBatcher.lat_percentiles(records),
