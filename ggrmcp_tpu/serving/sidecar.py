@@ -199,14 +199,19 @@ class Sidecar:
         # plain temperature sampling (rejection sampling — lossless in
         # distribution, ops/speculative.py). top-k/top-p filtering is
         # not implemented in the rejection sampler, so those requests
-        # take the continuous batcher. Adapters can't reach this gate:
-        # lora + speculative_draft is rejected at engine init
-        # (engine._init_lora), so a draft-configured sidecar resolves
-        # every request to the base model.
+        # take the continuous batcher — as do LONG prompts: speculative
+        # decoding wins on decode-bound traffic, but a long prompt is
+        # prefill-bound and the draft model would DOUBLE its prefill
+        # cost while bypassing the machinery built for it (chunked
+        # admission, length tiers, the prefix pool). Adapters can't
+        # reach this gate: lora + speculative_draft is rejected at
+        # engine init (engine._init_lora), so a draft-configured
+        # sidecar resolves every request to the base model.
         speculative = (
             self.generation.draft_fam is not None
             and sampling.top_k <= 0
             and sampling.top_p >= 1.0
+            and len(prompt) <= self.serving.batching.prefill_chunk
         )
         with tracing.tracer.span(
             "sidecar.generate",

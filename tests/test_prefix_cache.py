@@ -264,6 +264,80 @@ class TestPrefixCache:
             await batcher.stop()
 
 
+class TestFusedWaveAdmission:
+    """Round-5 perf property, pinned structurally: a same-preamble
+    WAVE admits through ONE fused prefix device call (the round-4
+    on-chip pathology was ~5 serial calls PER REQUEST), and the
+    outputs still match greedy runs of the uncached engine."""
+
+    async def test_wave_is_one_fused_device_call(self, engine):
+        batcher = ContinuousBatcher(engine, batching_cfg(max_batch_size=4))
+        batcher.warmup()
+        batcher.start()
+        head = prompt_of(24, salt=400)
+        try:
+            # Seed the pool (trickle miss → fused single admission +
+            # cache-slice store).
+            await collect(batcher, head + prompt_of(3, salt=401), 3)
+            calls = {"pfx": 0, "shapes": []}
+            real = batcher._admit_chunked_pfx
+
+            def counting(*args):
+                calls["pfx"] += 1
+                calls["shapes"].append(tuple(args[1].shape))
+                return real(*args)
+
+            batcher._admit_chunked_pfx = counting
+            outs = await asyncio.gather(*(
+                collect(batcher, head + prompt_of(3, salt=410 + i), 4,
+                        seed=i)
+                for i in range(3)
+            ))
+            assert all(r in ("length", "stop") for _, r in outs)
+            # The 3-request wave shares one geometry key -> ONE fused
+            # call at the full-pool row bucket ([B, 1, W]); a straggler
+            # admitted on a later round may add one more.
+            assert 1 <= calls["pfx"] <= 2, calls
+            assert all(s[0] == 4 and s[1] == 1 for s in calls["shapes"])
+        finally:
+            batcher._admit_chunked_pfx = real
+            await batcher.stop()
+
+    async def test_long_group_uses_bucketed_rows(self, engine):
+        """Long-prompt groups run at the bucketed row count, not the
+        full slot pool — a trickle 4k admission must not pay B x the
+        prefill compute (round-5 CPU regression, fixed)."""
+        batcher = ContinuousBatcher(
+            engine,
+            batching_cfg(
+                max_batch_size=4, kv_cache_max_seq=256,
+                prefill_chunk=32, prefix_cache_entries=0,
+            ),
+        )
+        batcher.warmup()
+        batcher.start()
+        shapes = []
+        real = batcher._admit_chunked
+
+        def counting(*args):
+            shapes.append(tuple(args[1].shape))
+            return real(*args)
+
+        batcher._admit_chunked = counting
+        try:
+            out, reason = await collect(
+                batcher, prompt_of(100, salt=500), 4
+            )
+            assert reason in ("length", "stop")
+            # One trickle admission: R=1 rows, T=ceil(100/32)=4 chunks.
+            long_shapes = [s for s in shapes if s[1] > 1 or s[0] == 1]
+            assert long_shapes and long_shapes[-1][0] == 1, shapes
+            assert long_shapes[-1][1] == 4, shapes
+        finally:
+            batcher._admit_chunked = real
+            await batcher.stop()
+
+
 # Heavy JAX-compile/serving integration module: excluded from the
 # fast `make test` signal; always in `make test-all` / CI.
 pytestmark = pytest.mark.slow
