@@ -45,6 +45,33 @@ def test_prefers_flagship_and_labels_banked(tmp_path, monkeypatch):
     assert "captured_at" in rec
 
 
+def test_archived_capture_banked_as_stale(tmp_path, monkeypatch):
+    """A round with no tunnel window re-emits the newest ARCHIVED
+    on-chip capture, loudly labeled stale — a previous round's real
+    silicon number beats measuring CPU noise, but must not read as a
+    fresh measurement."""
+    _isolate(tmp_path, monkeypatch)
+    old = tmp_path / "archive_20260101T000000Z"
+    new = tmp_path / "archive_20260201T000000Z"
+    old.mkdir()
+    new.mkdir()
+    _write(old / "bench_tpu.json",
+           '{"metric": "m", "value": 10.0, "platform": "tpu"}')
+    _write(new / "bench_tpu.json",
+           '{"metric": "m", "value": 20.0, "platform": "tpu"}')
+    rec = json.loads(bench._banked_tpu_line())
+    assert rec["value"] == 20.0  # newest archive wins
+    assert rec["stale_round"] is True and rec["banked"] is True
+    assert "note" in rec
+    # A CURRENT-round artifact always beats the archives and is NOT
+    # stale.
+    _write(tmp_path / "bench_tpu.json",
+           '{"metric": "m", "value": 30.0, "platform": "tpu"}')
+    rec = json.loads(bench._banked_tpu_line())
+    assert rec["value"] == 30.0
+    assert "stale_round" not in rec
+
+
 def test_cpu_fallback_lines_are_never_banked(tmp_path, monkeypatch):
     _isolate(tmp_path, monkeypatch)
     # the in-bench CPU fallback can write platform=cpu lines into the
