@@ -910,48 +910,59 @@ class ContinuousBatcher:
             # mid-request (minutes over a remote-compile TPU link).
             width = 32
             while width <= bucket_len(c, maximum=self.max_seq):
-                # Wave shape (R=B) — the agentic arrival pattern the
-                # pool exists for; trickle hits (R=1) compile on first
-                # use.
-                _, self.cache = self._admit_chunked_pfx(
-                    self.engine.params,
-                    jnp.asarray(np.zeros((b_rows, 1, width), np.int32)),
-                    jnp.asarray(zlenb), self.cache, jnp.asarray(zslotb),
-                    jnp.asarray(zseedb), jnp.asarray(zfb),
-                    jnp.asarray(zib), jnp.asarray(ofb), jnp.asarray(zib),
-                    self._pfx_pool, jnp.int32(0), jnp.int32(0),
-                )
+                # Hit shapes: the wave (R=B, the agentic arrival the
+                # pool exists for) AND the trickle single (R=1) —
+                # every compile here is one a live request never pays
+                # over a remote-compile TPU link.
+                for r_rows in (1, b_rows) if b_rows > 1 else (1,):
+                    _, self.cache = self._admit_chunked_pfx(
+                        self.engine.params,
+                        jnp.asarray(np.zeros((r_rows, 1, width), np.int32)),
+                        jnp.asarray(zlenb[:r_rows]), self.cache,
+                        jnp.asarray(zslotb[:r_rows]),
+                        jnp.asarray(zseedb[:r_rows]),
+                        jnp.asarray(zfb[:r_rows]),
+                        jnp.asarray(zib[:r_rows]),
+                        jnp.asarray(ofb[:r_rows]),
+                        jnp.asarray(zib[:r_rows]),
+                        self._pfx_pool, jnp.int32(0), jnp.int32(0),
+                    )
                 width *= 2
             # The SERIAL fallback (_prefill_chunked) still serves
             # prefix hits whose suffix needs a multi-step bridge plan
-            # (suffix > prefill_chunk). Warm its programs too —
-            # _pfx_load, the [1, w] bridge/chunk steps, _insert_row,
-            # _first_token — or that path pays cold compiles inline
-            # while admission and ticks share the serialized executor.
-            mini = self._pfx_load(
-                self._make_mini(1, self.max_seq), self._pfx_pool,
-                jnp.int32(0), jnp.int32(0),
-            )
-            logits, mini = self._chunk_step(
-                self.engine.params, jnp.asarray(np.zeros((1, c), np.int32)),
-                mini, jnp.asarray(zlen1), jnp.asarray(zi1),
-            )
-            width = 32
-            while width <= bucket_len(c, maximum=self.max_seq):
-                if width != c:
-                    logits, mini = self._chunk_step(
-                        self.engine.params,
-                        jnp.asarray(np.zeros((1, width), np.int32)),
-                        mini, jnp.asarray(zlen1), jnp.asarray(zi1),
-                    )
-                width *= 2
-            self.cache = self._insert_row(
-                self.cache, mini, jnp.int32(0), jnp.int32(0)
-            )
-            _ = self._first_token(
-                logits, jnp.asarray(zi1), jnp.asarray(zseed1),
-                jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
-            )
+            # (suffix > prefill_chunk) — REACHABLE only when an
+            # admissible prompt can outgrow the chunk beyond the
+            # shortest poolable prefix. Most tiers can't (e.g. a
+            # 512-cap tier with a 512 chunk): skip their serial warm
+            # ladder entirely — warmup compiles are real minutes over
+            # a remote-compile TPU link and every skipped program is
+            # budget returned to the capture window.
+            if self._fit_limit - self._pfx_min > c:
+                mini = self._pfx_load(
+                    self._make_mini(1, self.max_seq), self._pfx_pool,
+                    jnp.int32(0), jnp.int32(0),
+                )
+                logits, mini = self._chunk_step(
+                    self.engine.params,
+                    jnp.asarray(np.zeros((1, c), np.int32)),
+                    mini, jnp.asarray(zlen1), jnp.asarray(zi1),
+                )
+                width = 32
+                while width <= bucket_len(c, maximum=self.max_seq):
+                    if width != c:
+                        logits, mini = self._chunk_step(
+                            self.engine.params,
+                            jnp.asarray(np.zeros((1, width), np.int32)),
+                            mini, jnp.asarray(zlen1), jnp.asarray(zi1),
+                        )
+                    width *= 2
+                self.cache = self._insert_row(
+                    self.cache, mini, jnp.int32(0), jnp.int32(0)
+                )
+                _ = self._first_token(
+                    logits, jnp.asarray(zi1), jnp.asarray(zseed1),
+                    jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
+                )
         jax.block_until_ready(self.cache.k)
 
     def start(self) -> None:

@@ -94,12 +94,21 @@ class TestLoadConfig:
     def test_sidecar_serving_overrides(self):
         args = cli.build_parser().parse_args([
             "sidecar", "--port", "7001", "--model", "tiny-llama",
-            "--quantize", "int8",
+            "--quantize", "int8", "--speculative-draft", "tiny-llama",
         ])
         cfg = cli.load_config(args)
         assert cfg.serving.port == 7001
         assert cfg.serving.model == "tiny-llama"
         assert cfg.serving.quantize == "int8"
+        assert cfg.serving.speculative_draft == "tiny-llama"
+
+    def test_gateway_tpu_speculative_draft_flag(self):
+        args = cli.build_parser().parse_args([
+            "gateway", "--tpu", "--model", "tiny-llama",
+            "--speculative-draft", "tiny-llama",
+        ])
+        cfg = cli.load_config(args)
+        assert cfg.serving.speculative_draft == "tiny-llama"
 
     def test_invalid_flag_value_fails_validation(self):
         args = cli.build_parser().parse_args(
