@@ -317,8 +317,12 @@ async def _run_bench() -> dict:
     # the shared-preamble prefix phase rides the 512 tier, the
     # >=4096-token phase the long one.
     n_slots = min(64, max(8, sessions))
+    # Tier 0 (headline) disables its prefix pool (third element): the
+    # headline prompts are shorter than the pool minimum, so its pool
+    # would only cost HBM and warmup compiles — minutes of a capture
+    # window over the remote-compile TPU link.
     kv_tiers = (
-        [[128, n_slots], [512, n_slots], [long_tier_seq, 4]]
+        [[128, n_slots, 0], [512, n_slots], [long_tier_seq, 4]]
         if long_tier_seq > 512 else []
     )
     serving = ServingConfig(

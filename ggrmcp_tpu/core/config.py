@@ -588,13 +588,15 @@ class Config:
         tiers = self.serving.batching.kv_tiers
         if tiers:
             if not all(
-                isinstance(t, (list, tuple)) and len(t) == 2
+                isinstance(t, (list, tuple)) and len(t) in (2, 3)
                 and int(t[0]) > 0 and int(t[1]) > 0
+                and (len(t) == 2 or int(t[2]) >= 0)
                 for t in tiers
             ):
                 raise ValueError(
                     "batching.kv_tiers entries must be [max_seq, slots] "
-                    "pairs of positive ints"
+                    "or [max_seq, slots, prefix_entries] with positive "
+                    "max_seq/slots and prefix_entries >= 0"
                 )
             seqs = [int(t[0]) for t in tiers]
             if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):

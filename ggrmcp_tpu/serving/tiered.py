@@ -43,10 +43,22 @@ class TieredBatcher:
         self.engine = engine
         self.cfg = cfg
         self.tiers: list[ContinuousBatcher] = []
-        for max_seq, slots in cfg.kv_tiers:
+        for tier in cfg.kv_tiers:
+            # [max_seq, slots] or [max_seq, slots, prefix_entries]:
+            # the optional third element overrides the global prefix
+            # pool size for THIS tier (0 = off). A tier whose workload
+            # can't produce poolable prompts (e.g. a short headline
+            # tier under the pool's min length) shouldn't pay the
+            # pool's HBM or its warmup compiles — which are minutes
+            # over a remote-compile TPU link.
+            max_seq, slots = tier[0], tier[1]
             tier_cfg = dataclasses.replace(
                 cfg, max_batch_size=int(slots),
                 kv_cache_max_seq=int(max_seq), kv_tiers=[],
+                prefix_cache_entries=(
+                    int(tier[2]) if len(tier) > 2
+                    else cfg.prefix_cache_entries
+                ),
             )
             self.tiers.append(
                 ContinuousBatcher(engine, tier_cfg, eos_id=eos_id)
