@@ -288,7 +288,7 @@ class BatchingConfig:
     # for CPU test meshes, where compute dominates the round-trip).
     # "auto" = DECODE_STEPS_TPU on TPU devices, 1 elsewhere (resolved
     # by the batcher against the engine's mesh).
-    decode_steps_per_tick: object = "auto"  # "auto" | int >= 1
+    decode_steps_per_tick: "int | str" = "auto"  # "auto" | int >= 1
     # Pipelined decode ticks: dispatch tick N+1 (with device-resident
     # token feedback) BEFORE blocking on tick N's host copy, so the
     # host↔device round-trip overlaps the next tick's compute instead

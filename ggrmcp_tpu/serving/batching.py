@@ -90,6 +90,10 @@ class _Request:
 class ContinuousBatcher:
     """Slot-based continuous batching over a shared KV cache."""
 
+    # counter_stats() keys that aggregate by MAX across tiers, not sum
+    # (serving/tiered.py::TieredBatcher.stats).
+    MAX_STAT_KEYS = ("admit_ms_max",)
+
     def __init__(
         self,
         engine,  # GenerationEngine
@@ -609,6 +613,18 @@ class ContinuousBatcher:
         self._pfx_used[best[0]] = self._pfx_clock
         return best
 
+    def _pfx_covered(self, arr: np.ndarray, length: int) -> bool:
+        """True if some pooled key already covers the first `length`
+        tokens of `arr` — storing another entry for them could never
+        out-match it (shared by burst learning and the trickle store)."""
+        if self._pfx_pool is None:
+            return False
+        return any(
+            k is not None and len(k) >= length
+            and self._lcp(k, arr, length) == length
+            for k in self._pfx_keys
+        )
+
     def _pfx_storable(self, prompt: list[int]) -> Optional[np.ndarray]:
         """The key this prompt's prefix would pool under, or None if
         too short. (Whether pooling adds anything over an existing hit
@@ -700,12 +716,8 @@ class ContinuousBatcher:
             return
         _, lcp, row = best
         key = prompts[row][:lcp]
-        for k in self._pfx_keys:
-            if (
-                k is not None and len(k) >= lcp
-                and self._lcp(k, key, lcp) == lcp
-            ):
-                return  # an existing entry already covers this prefix
+        if self._pfx_covered(key, lcp):
+            return  # an existing entry already covers this prefix
         slot = slots_idx[row]
         self._pfx_commit(key, lambda entry: self._pfx_store_slot(
             self._pfx_pool, self.cache, jnp.int32(slot),
@@ -1348,16 +1360,7 @@ class ContinuousBatcher:
             # over an existing hit rides the same store.
             req = batch[0]
             key = self._pfx_storable(req.prompt)
-            hit_len = None
-            for k in self._pfx_keys if self._pfx_pool is not None else []:
-                if (
-                    k is not None
-                    and self._lcp(k, np.asarray(
-                        req.prompt[: self._pfx_max], np.int32
-                    ), len(k)) == len(k)
-                ):
-                    hit_len = max(hit_len or 0, len(k))
-            if key is not None and (hit_len is None or hit_len < len(key)):
+            if key is not None and not self._pfx_covered(key, len(key)):
                 slot = slots_idx[0]
                 self._pfx_commit(key, lambda entry: self._pfx_store_slot(
                     self._pfx_pool, self.cache, jnp.int32(slot),

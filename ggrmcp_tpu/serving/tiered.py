@@ -125,7 +125,7 @@ class TieredBatcher:
             **{
                 key: (
                     max(s[key] for s in per_tier)
-                    if key == "admit_ms_max"  # a max, not a sum
+                    if key in ContinuousBatcher.MAX_STAT_KEYS
                     else sum(s[key] for s in per_tier)
                 )
                 for key in per_tier[0]
