@@ -31,9 +31,12 @@ test-fast:
 	$(PY) -m pytest tests/ -q -x --ignore=tests/test_serving.py \
 	  --ignore=tests/test_models.py
 
+# CPU smoke of the full bench, including the mixed long-prompt+decode
+# workload phase (interleaved prefill on — A/B the serialized baseline
+# with GGRMCP_BENCH_INTERLEAVE=off; compare mixed_decode_stall_p99_ms).
 bench-cpu:
 	GGRMCP_BENCH_CPU=1 GGRMCP_BENCH_SESSIONS=8 GGRMCP_BENCH_CALLS=24 \
-	  $(PY) bench.py
+	  GGRMCP_BENCH_INTERLEAVE=on $(PY) bench.py
 
 # End-to-end smoke: graft entry + multichip dry run on the CPU mesh.
 smoke:

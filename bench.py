@@ -25,6 +25,11 @@ Environment knobs:
                          models whose dense init exceeds the chip HBM
                          (llama3-8b on v5e-1). Requires _QUANT=int8;
                          the result line carries synthetic_weights:true
+  GGRMCP_BENCH_INTERLEAVE  batching.prefill_interleave for the serving
+                         stack: "on" (default — long prompts landing
+                         mid-decode ride tick-fused chunks) or "off"
+                         (serialized fused-grid admission). A/B these
+                         to see mixed_decode_stall_p99_ms move.
   GGRMCP_BENCH_CPU=1     force the CPU platform (tiny model)
 """
 
@@ -38,6 +43,12 @@ import sys
 import tempfile
 import threading
 import time
+
+# Pure-python percentile helper (no jax import — safe for the isolated
+# proxy phase): the ceil-based nearest-rank formula shared with
+# ContinuousBatcher.lat_percentiles. The previous hand-rolled
+# `int(n*p)-1` read ~p98 at n=63 and indexed -1 at n<2.
+from ggrmcp_tpu.utils.stats import nearest_rank
 
 _OWNER_LOCK = threading.Lock()
 _OWNER = {"owner": None}
@@ -320,11 +331,19 @@ async def _run_bench() -> dict:
     # Tier 0 (headline) disables its prefix pool (third element): the
     # headline prompts are shorter than the pool minimum, so its pool
     # would only cost HBM and warmup compiles — minutes of a capture
-    # window over the remote-compile TPU link.
+    # window over the remote-compile TPU link. The long tier holds 6
+    # slots: the mixed-workload phase runs 3 background decoders plus
+    # concurrent long admissions in that one tier.
     kv_tiers = (
-        [[128, n_slots, 0], [512, n_slots], [long_tier_seq, 4]]
+        [[128, n_slots, 0], [512, n_slots], [long_tier_seq, 6]]
         if long_tier_seq > 512 else []
     )
+    # Stall-free prefill/decode interleaving (serving/batching.py):
+    # with "on", a long prompt admitted mid-decode advances one chunk
+    # per decode tick instead of serializing its whole [T, C] grid in
+    # front of every active slot. The mixed phase reports the resulting
+    # decode-stall percentiles; "off" A/Bs the serialized baseline.
+    interleave = os.environ.get("GGRMCP_BENCH_INTERLEAVE", "on")
     serving = ServingConfig(
         model=model,
         quantize=quantize,
@@ -345,6 +364,7 @@ async def _run_bench() -> dict:
             prefix_cache_entries=4,
             prefix_cache_min_seq=48,
             prefix_cache_max_seq=256,
+            prefill_interleave=interleave,
         ),
     )
     sidecar = Sidecar(serving)
@@ -420,7 +440,7 @@ async def _run_bench() -> dict:
         # with no output (it emits the stashed line and exits).
         calls_per_sec = total / elapsed
         p50 = statistics.median(latencies)
-        p99 = latencies[int(len(latencies) * 0.99) - 1]
+        p99 = nearest_rank(latencies, 0.99)
         n_chips = len(devices) if on_tpu else 1
         tokens_per_sec = calls_per_sec * max_new
 
@@ -614,9 +634,7 @@ async def _run_bench() -> dict:
                 "prefix_calls_per_sec": round(n_pfx / pfx_elapsed, 2),
                 "prefix_p50_ms": round(pfx_p50, 1),
                 "prefix_p99_ms": round(
-                    sorted(pfx_latencies[1:])[
-                        int(len(pfx_latencies[1:]) * 0.99) - 1
-                    ] * 1000, 1,
+                    nearest_rank(pfx_latencies[1:], 0.99) * 1000, 1,
                 ),
                 "prefix_cold_p50_ms": round(cold_p50, 1),
                 "prefix_hits": phase_hits,
@@ -723,6 +741,156 @@ async def _run_bench() -> dict:
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: long-prompt phase failed: {exc!r}", file=sys.stderr)
 
+        # Mixed-workload phase: long-prompt admissions landing WHILE
+        # other requests in the same tier are mid-decode — the
+        # "millions of users" arrival shape whose p99 the serialized
+        # admission path wrecks (one long prefill stalls every active
+        # slot for its whole duration). Background decoders and the
+        # long admissions both route to the long tier; the phase
+        # reports the decode-stall percentiles over exactly this
+        # window, the number prefill_interleave exists to bound.
+        mixed = {}
+        try:
+            if headline_only:
+                raise _SkipPhase()
+            tiers = getattr(sidecar.batcher, "tiers", None) or [
+                sidecar.batcher
+            ]
+            stall0 = [len(t.stall_snapshot()) for t in tiers]
+            ilv0 = sum(int(t.interleaved_chunks) for t in tiers)
+            # ~560 prompt tokens (byte tokenizer): past the 512 tier,
+            # so the background decode lives in the long tier with the
+            # admissions that will interrupt it.
+            bg_fill = "background decode traffic keeps a slot busy. "
+            bg_stop = asyncio.Event()
+            bg_done = {"calls": 0}
+
+            async def bg_loop(s: int) -> None:
+                i = 0
+                while not bg_stop.is_set():
+                    body = {
+                        "jsonrpc": "2.0", "method": "tools/call",
+                        "id": 70000 + s * 1000 + i,
+                        "params": {
+                            "name": tool,
+                            "arguments": {
+                                "prompt": (
+                                    f"bg {s} {i}: " + bg_fill * 13
+                                )[:560],
+                                "maxNewTokens": 3 * max_new,
+                            },
+                        },
+                    }
+                    resp = await client.post("/", json=body)
+                    data = await resp.json()
+                    if "error" in data:
+                        raise RuntimeError(
+                            f"mixed bg call failed: {data['error']}"
+                        )
+                    bg_done["calls"] += 1
+                    i += 1
+
+            mixed_latencies: list[float] = []
+
+            async def mixed_long_call(i: int) -> None:
+                reps = long_prompt_target // 24 + 2
+                text = f"mixed {i}: " + (
+                    "jumps over the lazy dog %03d " % i
+                ) * reps
+                body = {
+                    "jsonrpc": "2.0", "method": "tools/call",
+                    "id": 75000 + i,
+                    "params": {
+                        "name": tool,
+                        "arguments": {
+                            "prompt": text[:long_prompt_target],
+                            "maxNewTokens": max_new,
+                        },
+                    },
+                }
+                t = time.perf_counter()
+                resp = await client.post("/", json=body)
+                data = await resp.json()
+                mixed_latencies.append(time.perf_counter() - t)
+                if "error" in data:
+                    raise RuntimeError(
+                        f"mixed long call failed: {data['error']}"
+                    )
+
+            bg_tasks = [
+                asyncio.create_task(bg_loop(s)) for s in range(3)
+            ]
+            try:
+                # Wait until every background session has one full call
+                # behind it: slots are demonstrably cycling decode
+                # before the long admissions land mid-stream.
+                t_wait = time.perf_counter()
+                while bg_done["calls"] < 3:
+                    if time.perf_counter() - t_wait > 300:
+                        raise RuntimeError("mixed bg traffic never warmed")
+                    done = [g for g in bg_tasks if g.done()]
+                    if done:
+                        await done[0]  # surface its exception
+                    await asyncio.sleep(0.05)
+                n_mixed = 4
+                t_mixed = time.perf_counter()
+                results = await asyncio.gather(
+                    *(mixed_long_call(i) for i in range(n_mixed)),
+                    return_exceptions=True,
+                )
+                errs = [
+                    r for r in results if isinstance(r, BaseException)
+                ]
+                if errs:
+                    raise errs[0]
+                mixed_elapsed = time.perf_counter() - t_mixed
+            finally:
+                bg_stop.set()
+                bg_res = await asyncio.gather(
+                    *bg_tasks, return_exceptions=True
+                )
+            errs = [
+                r for r in bg_res
+                if isinstance(r, BaseException)
+                and not isinstance(r, asyncio.CancelledError)
+            ]
+            if errs:
+                raise errs[0]
+            # Decode stalls recorded DURING the phase (per-tier tails
+            # of the bounded record windows — approximate only if a
+            # tier overflowed its 4096-record deque mid-phase, which
+            # this phase's volume stays far under).
+            stall_new: list[float] = []
+            for t, n0 in zip(tiers, stall0):
+                stall_new.extend(t.stall_snapshot()[n0:])
+            mixed = {
+                "mixed_long_calls": n_mixed,
+                "mixed_long_calls_per_sec": round(
+                    n_mixed / mixed_elapsed, 2
+                ),
+                "mixed_long_p50_ms": round(
+                    statistics.median(mixed_latencies) * 1000, 1
+                ),
+                "mixed_bg_calls": bg_done["calls"],
+                "mixed_decode_stall_p50_ms": round(
+                    nearest_rank(stall_new, 0.5), 1
+                ),
+                "mixed_decode_stall_p99_ms": round(
+                    nearest_rank(stall_new, 0.99), 1
+                ),
+                "mixed_decode_stall_max_ms": round(
+                    max(stall_new), 1
+                ) if stall_new else 0.0,
+                "mixed_interleaved_chunks": (
+                    sum(int(t.interleaved_chunks) for t in tiers) - ilv0
+                ),
+                "prefill_interleave": interleave,
+            }
+        except _SkipPhase:
+            pass
+        except Exception as exc:  # secondary phase must not sink the run
+            print(f"bench: mixed phase failed: {exc!r}", file=sys.stderr)
+
     # Per-tick timing breakdown (round-4 verdict #1c: show where the
     # milliseconds live — host dispatch vs device compute/transfer vs
     # admission — so the RTT-bound hypothesis is checkable from the
@@ -777,7 +945,9 @@ async def _run_bench() -> dict:
             proxy = await _proxy_bench_isolated()
         except Exception as exc:  # secondary metric must not sink the run
             print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
-    return {**headline, **hbm, **prefix, **longp, **ticktime, **proxy}
+    return {
+        **headline, **hbm, **prefix, **longp, **mixed, **ticktime, **proxy,
+    }
 
 
 def _kill_proxy_group() -> None:
@@ -942,7 +1112,7 @@ async def _proxy_bench() -> dict:
         "proxy_calls_per_sec": rate,
         "proxy_calls_per_sec_waves": [m[0] for m in measured],
         "proxy_p50_ms": round(statistics.median(latencies), 2),
-        "proxy_p99_ms": round(latencies[int(len(latencies) * 0.99) - 1], 2),
+        "proxy_p99_ms": round(nearest_rank(latencies, 0.99), 2),
         "proxy_procs": procs,
         "proxy_sessions": procs * sess_per_proc,
         "proxy_backend_transport": "uds" if use_uds else "tcp",

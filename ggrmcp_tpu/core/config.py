@@ -329,6 +329,22 @@ class BatchingConfig:
     # (its prefill would be wasted — the client has long given up).
     # 0 = wait forever.
     queue_deadline_ms: float = 0.0
+    # Stall-free prefill/decode interleaving (the Sarathi-Serve
+    # insight, Agrawal et al. 2024): "on" admits long prompts (>
+    # prefill_chunk) arriving while slots are decoding as per-tick
+    # chunk work — each fused device call runs the decode tick AND at
+    # most one [R<=K, prefill_chunk] prefill chunk, so an active
+    # slot's token emission never gaps by more than ~one chunk's
+    # compute instead of the full prompt prefill. "off" keeps the
+    # serialized fused-grid admission (whole [T, C] grid in one call —
+    # still the fastest path when nothing is decoding, and what the
+    # interleaved path itself falls back to on an idle pool).
+    prefill_interleave: str = "off"  # off | on
+    # Max admitting rows advanced per fused tick+chunk call (the K in
+    # [R<=K, C]); also the carried mini-cache's row count, so HBM cost
+    # is K x kv_cache_max_seq of KV. Further long prompts queue for a
+    # free row.
+    prefill_interleave_rows: int = 4
 
 
 # decode_steps_per_tick="auto" resolves to this on TPU meshes: with
@@ -570,6 +586,12 @@ class Config:
             raise ValueError("p50_budget_ms must be >= 0 (0 = off)")
         if self.serving.batching.queue_deadline_ms < 0:
             raise ValueError("queue_deadline_ms must be >= 0 (0 = off)")
+        if self.serving.batching.prefill_interleave not in ("off", "on"):
+            raise ValueError(
+                "batching.prefill_interleave must be one of off/on"
+            )
+        if self.serving.batching.prefill_interleave_rows < 1:
+            raise ValueError("batching.prefill_interleave_rows must be >= 1")
         if self.serving.speculative_gamma < 1:
             raise ValueError("speculative_gamma must be >= 1")
         if self.training.steps < 1 or self.training.batch_size < 1:

@@ -38,6 +38,7 @@ from ggrmcp_tpu.gateway.handler import MCPHandler, SSETransport
 from ggrmcp_tpu.gateway.middleware import _KNOWN_PATHS, TokenBucket
 from ggrmcp_tpu.mcp import types as mcp
 from ggrmcp_tpu.utils import tracing
+from ggrmcp_tpu.utils.aio_compat import timeout as aio_timeout
 
 logger = logging.getLogger("ggrmcp.gateway.http")
 
@@ -500,11 +501,11 @@ class FastLaneServer:
                 )
             else:
                 try:
-                    async with asyncio.timeout(self.request_timeout_s):
+                    async with aio_timeout(self.request_timeout_s):
                         status = await self._route(
                             conn, method, target, path, headers, pairs, body
                         )
-                except TimeoutError:
+                except (TimeoutError, asyncio.TimeoutError):
                     status = 504
                     if conn.sse_started:
                         # Stream headers already went out — an HTTP 504

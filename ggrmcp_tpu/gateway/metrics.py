@@ -115,6 +115,14 @@ class GatewayMetrics:
                 ("decode_steps", "fused decode steps issued"),
                 ("speculative_calls", "speculative device calls"),
                 ("speculative_requests", "requests served speculatively"),
+                ("interleaved_chunks",
+                 "prefill chunks fused into decode ticks"),
+                ("interleaved_admissions",
+                 "requests admitted via tick-interleaved prefill"),
+                ("decode_stall_ms_p50",
+                 "median gap between a live slot's token emissions"),
+                ("decode_stall_ms_p99",
+                 "p99 gap between a live slot's token emissions"),
             ]
         }
         # labels() re-validates and re-hashes label values every call
@@ -180,7 +188,10 @@ class GatewayMetrics:
             live.add(target)
             for name, gauge in self.serving_gauges.items():
                 value = entry.get(_snake_to_camel(name), 0)
-                self._child(gauge, target).set(int(value))
+                # float, not int: protojson renders int64 counters as
+                # strings and doubles as numbers — float() takes both,
+                # and the millisecond stall gauges carry fractions.
+                self._child(gauge, target).set(float(value))
         for target in self._serving_targets - live:
             for gauge in self.serving_gauges.values():
                 try:

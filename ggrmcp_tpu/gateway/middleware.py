@@ -19,6 +19,7 @@ from aiohttp import web
 
 from ggrmcp_tpu.core.config import ServerConfig
 from ggrmcp_tpu.gateway.metrics import GatewayMetrics
+from ggrmcp_tpu.utils.aio_compat import timeout as aio_timeout
 from ggrmcp_tpu.mcp import types as mcp
 
 logger = logging.getLogger("ggrmcp.gateway.http")
@@ -279,9 +280,9 @@ def fused_middleware(cfg: ServerConfig, metrics: GatewayMetrics) -> Callable:
                     )
                     return _finish(request, response, start)
                 try:
-                    async with asyncio.timeout(cfg.request_timeout_s):
+                    async with aio_timeout(cfg.request_timeout_s):
                         response = await handler(request)
-                except TimeoutError:
+                except (TimeoutError, asyncio.TimeoutError):
                     response = web.json_response(
                         mcp.make_error_response(
                             None, mcp.INTERNAL_ERROR, "request timed out"

@@ -353,8 +353,9 @@ def flash_attention_sharded(
     (ops/ring_attention.py). Must run under jit (partial-manual
     shard_map with manual-axis out_specs is rejected eagerly by this
     JAX version)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ggrmcp_tpu.utils.jax_compat import shard_map
 
     b = q.shape[0]
     ok, why = _flash_shardable(mesh, b, k.shape[2])

@@ -26,8 +26,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ggrmcp_tpu.utils.jax_compat import shard_map
 
 from ggrmcp_tpu.ops.attention import NEG_INF, attention_xla
 

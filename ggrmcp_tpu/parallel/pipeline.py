@@ -42,6 +42,7 @@ from ggrmcp_tpu.models import common
 from ggrmcp_tpu.models import llama as llama_mod
 from ggrmcp_tpu.ops import quant
 from ggrmcp_tpu.parallel import mesh as mesh_mod
+from ggrmcp_tpu.utils.jax_compat import shard_map
 
 
 def stage_count(mesh: Mesh) -> int:
@@ -122,7 +123,7 @@ def pipeline_layers(
 
     layer_specs = jax.tree_util.tree_map(lambda _: P("stage"), layers)
     fwd = partial(_pipelined, cfg=cfg, fam=fam, num_stages=S, num_micro=M)
-    out, aux = jax.shard_map(
+    out, aux = shard_map(
         fwd,
         mesh=mesh,
         axis_names={"stage"},
@@ -306,7 +307,7 @@ def pipeline_forward_cached(
         _pipelined_cached, cfg=cfg, fam=fam, num_stages=S_stages,
         num_micro=M, mb=mb, ring=ring,
     )
-    out, new_k, new_v = jax.shard_map(
+    out, new_k, new_v = shard_map(
         fwd,
         mesh=mesh,
         axis_names={"stage"},

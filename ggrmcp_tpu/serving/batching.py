@@ -31,6 +31,7 @@ from ggrmcp_tpu.models import llama as llama_mod
 from ggrmcp_tpu.ops import quant
 from ggrmcp_tpu.ops.sampling import SamplingConfig, sample_dynamic
 from ggrmcp_tpu.serving.engine import bucket_len, fit_request
+from ggrmcp_tpu.utils.stats import nearest_rank
 
 logger = logging.getLogger("ggrmcp.serving.batching")
 
@@ -61,6 +62,22 @@ class _Slot:
     generated: int = 0
     max_new: int = 0
     done: bool = False
+    # Held by an interleaved (chunk-at-a-time) admission in progress:
+    # not yet decoding, but not free either — _free_slots skips it
+    # until the final chunk lands and _activate_slot flips it active.
+    reserved: bool = False
+
+
+@dataclasses.dataclass
+class _IlvRow:
+    """One admitting row of the interleave mini cache: host-side
+    progress for a long prompt advancing one [1, C] chunk per fused
+    tick+chunk call (prefill_interleave=on)."""
+
+    request: "_Request"
+    slot: int
+    n: int  # prompt length
+    progress: int = 0  # tokens already written into the mini row
 
 
 @dataclasses.dataclass
@@ -226,6 +243,13 @@ class ContinuousBatcher:
         # (queue_ms, service_ms) per completed request — queue = submit
         # to slot activation, service = activation to terminal chunk.
         self._lat_records: deque = deque(maxlen=4096)
+        # Decode-stall histogram: wall-clock gaps (ms) between
+        # consecutive token emissions to a slot while its request is
+        # live — the per-slot observable the prefill-interleave mode
+        # exists to bound (serialized long-prompt admission shows up
+        # here as one full-prefill-sized gap on every active slot).
+        self._stall_records: deque = deque(maxlen=4096)
+        self._slot_last_emit: list = [None] * b
         # EMA of per-row admission cost, feeding the p50_budget_ms
         # admission cap (start pessimistic so a cold first round under
         # an SLO config stays small until measured).
@@ -277,6 +301,32 @@ class ContinuousBatcher:
         self._pfx_store = jax.jit(self._pfx_store_impl)
         self._pfx_store_slot = jax.jit(self._pfx_store_slot_impl)
         self._pfx_load = jax.jit(self._pfx_load_impl, donate_argnums=(0,))
+        # Stall-free prefill/decode interleaving (prefill_interleave=
+        # "on"): long prompts arriving mid-decode become per-tick chunk
+        # work items instead of one serialized [T, C] grid call. Each
+        # fused tick+chunk call runs the decode scan AND extends at
+        # most one [K, C] chunk of the carried [K, S_max] mini cache
+        # (per-row write offsets stamped host-side each call); the
+        # final chunk's row scatters into the shared cache via
+        # _ilv_finish (the _merge_row machinery) and activates the
+        # slot. K = prefill_interleave_rows; further long prompts
+        # queue in _ilv_pending holding a reserved slot.
+        self._ilv_k = (
+            max(1, int(getattr(self.cfg, "prefill_interleave_rows", 4)))
+            if getattr(self.cfg, "prefill_interleave", "off") == "on"
+            else 0
+        )
+        self._ilv_rows: list = [None] * self._ilv_k
+        self._ilv_pending: deque = deque()
+        self._ilv_mini = None  # lazily _make_mini(K, max_seq)
+        self.interleaved_chunks = 0
+        self.interleaved_admissions = 0
+        self._tick_chunk = jax.jit(
+            self._tick_chunk_impl, donate_argnums=(2, 11)
+        )
+        self._ilv_finish = jax.jit(
+            self._ilv_finish_impl, donate_argnums=(0,)
+        )
 
     def _make_mini(self, rows: int, length: int):
         """Admission mini cache matching the engine's KV storage."""
@@ -443,15 +493,14 @@ class ContinuousBatcher:
             cache, mini, slots, true_len, fl, seeds, temps, ks, ps
         )
 
-    def _tick_impl(
+    def _decode_scan(
         self, params, tokens, cache, seeds, step, temps, ks, ps, active,
         adapters,
     ):
-        """One device call = `decode_steps_per_tick` fused decode steps
-        (lax.scan). Fewer host round-trips per token: tokens sampled
-        after a slot's EOS/max_new are dropped host-side in
-        `_emit_chunk` (the cache rows they touched are masked by
-        `length` on slot reuse)."""
+        """`decode_steps_per_tick` fused decode steps (lax.scan) — the
+        shared core of the plain tick and the fused tick+chunk program,
+        so interleaved admission cannot perturb decode numerics by
+        construction. Returns (toks [B, steps], cache)."""
 
         def body(carry, i):
             cur, cache = carry
@@ -468,6 +517,83 @@ class ContinuousBatcher:
             body, (tokens, cache), jnp.arange(self._steps_per_tick)
         )
         return toks.T, cache  # [B, steps_per_tick]
+
+    def _tick_impl(
+        self, params, tokens, cache, seeds, step, temps, ks, ps, active,
+        adapters,
+    ):
+        """One device call = `decode_steps_per_tick` fused decode steps
+        (lax.scan). Fewer host round-trips per token: tokens sampled
+        after a slot's EOS/max_new are dropped host-side in
+        `_emit_chunk` (the cache rows they touched are masked by
+        `length` on slot reuse)."""
+        return self._decode_scan(
+            params, tokens, cache, seeds, step, temps, ks, ps, active,
+            adapters,
+        )
+
+    def _tick_chunk_impl(
+        self, params, tokens, cache, seeds, step, temps, ks, ps, active,
+        adapters, chunk, mini, offs, c_true_len, c_valid, c_adapters,
+    ):
+        """Fused tick+chunk (prefill_interleave=on): the decode scan for
+        every slot AND at most one [K, C] prefill chunk for admitting
+        rows, in ONE device call — an active slot's emission gaps by
+        one chunk's compute, never a whole prompt's prefill.
+
+        The chunk part extends the carried [K, S_max] mini cache at the
+        host-stamped per-row offsets `offs` (authoritative each call,
+        so idle rows — c_valid False — can run junk chunks without
+        drifting state: their next occupant re-stamps offset 0 and
+        overwrites). Returns each row's logits at its final prompt
+        position within THIS chunk (`sel`); the host uses sel[r] only
+        for rows whose last chunk this was. Numerics match the
+        serialized chunked grid: same chunk widths, same offsets, same
+        final-position gather — only the batch row count differs, which
+        is row-independent math."""
+        toks, cache = self._decode_scan(
+            params, tokens, cache, seeds, step, temps, ks, ps, active,
+            adapters,
+        )
+        mini = mini._replace(length=offs)
+        c = chunk.shape[1]
+        if self._is_moe:
+            valid = c_valid[:, None] & (
+                (offs[:, None] + jnp.arange(c)[None, :])
+                < c_true_len[:, None]
+            )
+        else:
+            valid = None
+        logits, mini = self.engine.decode_forward(
+            params, chunk, mini, valid=valid, ring=self._ring,
+            lora_idx=c_adapters,
+        )
+        last = c_true_len - 1
+        idx = jnp.clip(last - offs, 0, c - 1)
+        sel = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
+        return toks, cache, mini, sel.astype(jnp.float32)
+
+    def _ilv_finish_impl(
+        self, cache, mini, row, slot, n, sel, seeds, temps, ks, ps,
+    ):
+        """Final-chunk completion for one interleaved admission: copy
+        mini row `row` into the shared cache at `slot` with true length
+        `n` (the _merge_row machinery — same as _insert_row) and sample
+        the first token from that row's final-position logits `sel`
+        (step 0, matching _chunked_finish/_first_token)."""
+
+        def pick(m):
+            return jax.lax.dynamic_slice_in_dim(m, row, 1, axis=1)
+
+        picked = llama_mod.KVCache(
+            k=quant.kv_map(pick, mini.k),
+            v=quant.kv_map(pick, mini.v),
+            length=jnp.full((1,), n, jnp.int32),
+        )
+        cache = _merge_row(cache, picked, slot, n)
+        fl = jax.lax.dynamic_slice_in_dim(sel, row, 1, axis=0)
+        first = sample_dynamic(fl, seeds, jnp.int32(0), temps, ks, ps)
+        return first, cache
 
     def _chunk_step_impl(self, params, tokens, mini, true_len, adapter):
         """One [1, C] prefill chunk appended to the row's mini cache at
@@ -802,6 +928,7 @@ class ContinuousBatcher:
         slot.generated = 0
         slot.max_new = request.max_new
         slot.done = False
+        slot.reserved = False
         request.t_admit = time.perf_counter()
         request.queue_ms = (request.t_admit - request.t_submit) * 1000.0
         self.cur_tokens[slot_idx] = first_tok
@@ -901,6 +1028,37 @@ class ContinuousBatcher:
                     jnp.asarray(ofb[:r_bucket]),
                     jnp.asarray(zib[:r_bucket]),
                 )
+        if self._ilv_k and (
+            self.cfg.prefill_chunk < self._fit_limit or self._ring
+        ):
+            # Fused tick+chunk + row-finish programs (ONE shape each):
+            # a long prompt landing mid-decode must not pay a cold
+            # compile inside the very stall interleaving exists to
+            # bound. Inert inputs: no valid chunk rows, no active
+            # slots, finish into slot 0 with length 0 — pre-serving
+            # only, like every other warmup call here.
+            if self._ilv_mini is None:
+                self._ilv_mini = self._make_mini(self._ilv_k, self.max_seq)
+            k_rows = self._ilv_k
+            _, self.cache, self._ilv_mini, sel = self._tick_chunk(
+                self.engine.params, jnp.asarray(self.cur_tokens),
+                self.cache, jnp.asarray(self.seeds), jnp.int32(0),
+                jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+                jnp.asarray(self.top_ps),
+                jnp.asarray(np.zeros((b,), bool)),
+                jnp.asarray(np.zeros((b,), np.int32)),
+                jnp.asarray(np.zeros((k_rows, c), np.int32)),
+                self._ilv_mini,
+                jnp.asarray(np.zeros((k_rows,), np.int32)),
+                jnp.asarray(np.ones((k_rows,), np.int32)),
+                jnp.asarray(np.zeros((k_rows,), bool)),
+                jnp.asarray(np.zeros((k_rows,), np.int32)),
+            )
+            _, self.cache = self._ilv_finish(
+                self.cache, self._ilv_mini, jnp.int32(0), jnp.int32(0),
+                jnp.int32(0), sel, jnp.asarray(zseed1),
+                jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
+            )
         if self._pfx_pool is not None:
             # plen=0 and no host-side key: the warmup entry can never
             # match a lookup. Store programs first (mini from a plain
@@ -1031,13 +1189,20 @@ class ContinuousBatcher:
         )
         request = _Request(
             prompt=prompt, max_new=max_new, sampling=sampling, seed=seed,
-            unary=unary, adapter=adapter, t_submit=time.perf_counter(),
+            unary=unary, adapter=adapter,
         )
         return self._consume(request)
 
     async def _consume(
         self, request: _Request
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
+        # The queue clock (queue_deadline_ms, queue_ms accounting)
+        # starts when the request actually enters `pending` — NOT at
+        # submit(): generators run lazily, so a caller that builds
+        # several iterators before consuming any would otherwise burn
+        # deadline on its own scheduling. Validation stays eager in
+        # submit() (bad arguments still fail at the call site).
+        request.t_submit = time.perf_counter()
         await self.pending.put(request)
         self._wake.set()
         try:
@@ -1050,16 +1215,37 @@ class ContinuousBatcher:
             request.cancelled = True
 
     def cache_bytes(self) -> int:
-        """KV-cache HBM: the shared slot pool plus the prefix pool."""
+        """KV-cache HBM: the shared slot pool, the prefix pool, and
+        the interleave mini cache (K admission rows) once allocated."""
         total = self.cache.k.nbytes + self.cache.v.nbytes
         if self._pfx_pool is not None:
             total += self._pfx_pool.k.nbytes + self._pfx_pool.v.nbytes
+        if self._ilv_mini is not None:
+            total += self._ilv_mini.k.nbytes + self._ilv_mini.v.nbytes
         return total
 
     def lat_snapshot(self) -> list[tuple[float, float]]:
         """Snapshot of recent (queue_ms, service_ms) records (the
         tiered facade concatenates these across tiers)."""
         return list(self._lat_records)
+
+    def stall_snapshot(self) -> list[float]:
+        """Snapshot of recent decode-stall samples (ms between
+        consecutive emissions to a live slot); concatenated across
+        tiers by the tiered facade, like lat_snapshot."""
+        return list(self._stall_records)
+
+    @staticmethod
+    def stall_percentiles(records: list[float]) -> dict:
+        """Decode-stall histogram summary — the admission-induced gap
+        distribution prefill_interleave bounds to ~one chunk."""
+        return {
+            "decode_stall_ms_p50": round(nearest_rank(records, 0.5), 2),
+            "decode_stall_ms_p99": round(nearest_rank(records, 0.99), 2),
+            "decode_stall_ms_max": (
+                round(max(records), 2) if records else 0.0
+            ),
+        }
 
     @staticmethod
     def lat_percentiles(records: list[tuple[float, float]]) -> dict:
@@ -1074,10 +1260,9 @@ class ContinuousBatcher:
 
         def pct(vals: list[float], p: float) -> float:
             # Nearest-rank: ceil(n*p)-th smallest — at n=100, p99 is
-            # vals[98], not the window max.
-            vals = sorted(vals)
-            idx = max(0, -(-len(vals) * p // 1) - 1)
-            return round(vals[min(len(vals) - 1, int(idx))], 2)
+            # vals[98], not the window max (utils/stats.py, shared
+            # with the bench's reported percentiles).
+            return round(nearest_rank(vals, p), 2)
 
         qs = [r[0] for r in records]
         ss = [r[1] for r in records]
@@ -1092,6 +1277,7 @@ class ContinuousBatcher:
         return {
             **self.counter_stats(),
             **self.lat_percentiles(self.lat_snapshot()),
+            **self.stall_percentiles(self.stall_snapshot()),
         }
 
     def counter_stats(self) -> dict:
@@ -1110,6 +1296,10 @@ class ContinuousBatcher:
             "prefix_cache_misses": self.prefix_misses,
             "decode_steps": self.step_counter,
             "timed_out": self.timed_out,
+            # Interleaved (tick-fused) admission activity: chunks
+            # piggybacked onto decode ticks / requests admitted that way.
+            "interleaved_chunks": self.interleaved_chunks,
+            "interleaved_admissions": self.interleaved_admissions,
             # Per-tick timing breakdown (cumulative ms + counts):
             # dispatch = host-side tick launch, collect = blocking
             # token pull (device wait + transfer), admit = full
@@ -1129,16 +1319,27 @@ class ContinuousBatcher:
     # -- the loop -----------------------------------------------------------
 
     def _free_slots(self) -> list[int]:
-        return [i for i, s in enumerate(self.slots) if not s.active]
+        return [
+            i for i, s in enumerate(self.slots)
+            if not s.active and not s.reserved
+        ]
 
     def _active_count(self) -> int:
         return sum(s.active for s in self.slots)
+
+    def _ilv_busy(self) -> bool:
+        """Interleaved admissions in flight (rows chunking or queued
+        for a row) — the loop must keep ticking for them even with no
+        active decode slot."""
+        return any(r is not None for r in self._ilv_rows) or bool(
+            self._ilv_pending
+        )
 
     async def _loop(self) -> None:
         loop = asyncio.get_running_loop()
         while not self._stopping:
             admitted = await self._admit()
-            if self._active_count() == 0:
+            if self._active_count() == 0 and not self._ilv_busy():
                 if self._inflight:
                     # The last live requests finished while a pipelined
                     # tick was already dispatched: drain it (its rows'
@@ -1184,6 +1385,18 @@ class ContinuousBatcher:
             slot.active = False
             slot.request = None
             slot.done = False
+            slot.reserved = False
+        # In-flight interleaved admissions die with the tick: the fused
+        # call donated their mini cache alongside the shared one.
+        for st in list(self._ilv_rows) + list(self._ilv_pending):
+            if st is not None:
+                self._loop_ref.call_soon_threadsafe(
+                    st.request.out.put_nowait, ([], "error")
+                )
+        self._ilv_rows = [None] * self._ilv_k
+        self._ilv_pending.clear()
+        self._ilv_mini = None
+        self._slot_last_emit = [None] * len(self.slots)
         # The tick donated the shared cache, so its buffers are dead
         # after an error — rebuild, or every future admission scatter
         # would fail and no request could ever succeed. The in-flight
@@ -1227,8 +1440,12 @@ class ContinuousBatcher:
                     timeout = deadline - time.monotonic()
                     if timeout <= 0 or admitted + len(batch) >= len(self.slots):
                         break
-                    if self._active_count() > 0 or admitted > 0 or batch:
-                        # Don't stall running decodes for stragglers.
+                    if (
+                        self._active_count() > 0 or admitted > 0 or batch
+                        or self._ilv_busy()
+                    ):
+                        # Don't stall running decodes (or in-flight
+                        # interleaved chunk work) for stragglers.
                         request = self.pending.get_nowait()
                     else:
                         request = await asyncio.wait_for(
@@ -1314,6 +1531,14 @@ class ContinuousBatcher:
         fused_batch: list[_Request] = []
         pfx_groups: dict[tuple, list[tuple[int, _Request]]] = {}
         long_rows: list[tuple[int, _Request]] = []
+        queued = 0  # rows diverted to the interleave queue (no prefill)
+        # Interleave long prompts only while decode (or earlier chunk
+        # work) is in flight: on an idle pool the serialized fused grid
+        # is strictly better (one device call vs T round-trips), and
+        # there is nothing to stall anyway.
+        ilv = self._ilv_k > 0 and (
+            self._active_count() > 0 or self._ilv_busy()
+        )
         trickle = len(batch) == 1
         for sl, req in zip(slots_idx, batch):
             # The prefix pool holds BASE-model KV only: a pooled prefix
@@ -1339,7 +1564,16 @@ class ContinuousBatcher:
                 else:
                     self._prefill_chunked(sl, req, pfx)
             elif len(req.prompt) > self.cfg.prefill_chunk:
-                long_rows.append((sl, req))
+                if ilv:
+                    # Chunk work item: the slot is held (reserved) but
+                    # the prefill rides the decode ticks one chunk at a
+                    # time instead of monopolizing this admission round.
+                    self.slots[sl].reserved = True
+                    self._ilv_pending.append(_IlvRow(req, sl, len(req.prompt)))
+                    self.interleaved_admissions += 1
+                    queued += 1
+                else:
+                    long_rows.append((sl, req))
             else:
                 fused_slots.append(sl)
                 fused_batch.append(req)
@@ -1370,9 +1604,15 @@ class ContinuousBatcher:
         self.timing["admit_ms"] += dt
         self.timing["admit_ms_max"] = max(self.timing["admit_ms_max"], dt)
         self.timing["admit_rounds"] += 1
-        self._admit_ema_ms = (
-            0.7 * self._admit_ema_ms + 0.3 * dt / max(1, len(batch))
-        )
+        # Interleave-queued rows ran no prefill here — feeding their
+        # ~zero cost into the EMA would let the p50_budget_ms cap admit
+        # unbounded short-prompt bursts on the strength of cheap
+        # enqueues.
+        prefilled = len(batch) - queued
+        if prefilled:
+            self._admit_ema_ms = (
+                0.7 * self._admit_ema_ms + 0.3 * dt / prefilled
+            )
 
     def _admit_chunked_group(
         self,
@@ -1513,12 +1753,17 @@ class ContinuousBatcher:
             self._pfx_learn_from_burst(slots_idx, batch)
 
     def _tick_step(self) -> None:
-        """One loop turn of decode work: dispatch a tick, then collect
-        down to the pipeline depth. Synchronous mode (pipeline_ticks
-        off) collects the tick it just dispatched — the classic loop;
-        pipelined mode leaves it in flight and collects the PREVIOUS
-        one, so the host pull of tick N overlaps tick N+1's compute."""
-        self._tick_dispatch()
+        """One loop turn of decode work: dispatch a tick (fused with at
+        most one prefill chunk when interleaved admissions are in
+        flight), then collect down to the pipeline depth. Synchronous
+        mode (pipeline_ticks off) collects the tick it just dispatched
+        — the classic loop; pipelined mode leaves it in flight and
+        collects the PREVIOUS one, so the host pull of tick N overlaps
+        tick N+1's compute."""
+        if self._ilv_busy():
+            self._tick_dispatch_chunk()
+        else:
+            self._tick_dispatch()
         depth = 1 if self._pipeline else 0
         while len(self._inflight) > depth:
             self._tick_collect_one()
@@ -1551,6 +1796,93 @@ class ContinuousBatcher:
         self._inflight.append((toks, owners))
         self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
         self.timing["ticks"] += 1
+
+    def _ilv_fill_rows(self) -> None:
+        """Claim queued chunk work items into free interleave rows."""
+        for r in range(self._ilv_k):
+            if self._ilv_rows[r] is None and self._ilv_pending:
+                self._ilv_rows[r] = self._ilv_pending.popleft()
+
+    def _tick_dispatch_chunk(self) -> None:
+        """_tick_dispatch's interleaved twin: ONE fused device call =
+        the decode scan for every slot PLUS at most one [K, C] prefill
+        chunk advancing the admitting rows' mini caches. Rows whose
+        final chunk this was finish right after (merge + first-token
+        sample + activation — one small device call each, once per
+        admission)."""
+        self._ilv_fill_rows()
+        t0 = time.perf_counter()
+        step0 = self.step_counter
+        self.step_counter += self._steps_per_tick
+        active = np.array([s.active for s in self.slots], bool)
+        if self._cur_dev is None:
+            self._cur_dev = jnp.asarray(self.cur_tokens)
+        if self._ilv_mini is None:
+            self._ilv_mini = self._make_mini(self._ilv_k, self.max_seq)
+        k = self._ilv_k
+        c = min(self.cfg.prefill_chunk, self.max_seq)
+        chunk = np.zeros((k, c), np.int32)
+        offs = np.zeros((k,), np.int32)
+        c_tl = np.ones((k,), np.int32)
+        c_valid = np.zeros((k,), bool)
+        c_adapt = np.zeros((k,), np.int32)
+        for r, st in enumerate(self._ilv_rows):
+            if st is None:
+                continue
+            piece = st.request.prompt[st.progress : st.progress + c]
+            chunk[r, : len(piece)] = piece
+            offs[r] = st.progress
+            c_tl[r] = st.n
+            c_valid[r] = True
+            c_adapt[r] = st.request.adapter
+        toks, self.cache, self._ilv_mini, sel = self._tick_chunk(
+            self.engine.params, self._cur_dev, self.cache,
+            jnp.asarray(self.seeds), jnp.int32(step0 + 1),
+            jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+            jnp.asarray(self.top_ps), jnp.asarray(active),
+            jnp.asarray(self.adapter_ids),
+            jnp.asarray(chunk), self._ilv_mini, jnp.asarray(offs),
+            jnp.asarray(c_tl), jnp.asarray(c_valid), jnp.asarray(c_adapt),
+        )
+        self._cur_dev = toks[:, -1]
+        try:
+            toks.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        owners = [s.request if s.active else None for s in self.slots]
+        self._inflight.append((toks, owners))
+        self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
+        self.timing["ticks"] += 1
+        done: list[int] = []
+        for r, st in enumerate(self._ilv_rows):
+            if st is None:
+                continue
+            self.interleaved_chunks += 1
+            st.progress += c
+            if st.progress >= st.n:
+                done.append(r)
+        for r in done:
+            self._ilv_finish_row(r, sel)
+
+    def _ilv_finish_row(self, r: int, sel) -> None:
+        """Complete interleave row `r`: scatter its mini row into the
+        shared cache, sample the first token from `sel[r]`, activate
+        the held slot. The int() materialization forces any async
+        device failure to surface HERE, inside _tick_step's try, where
+        _reset_after_tick_failure owns the cleanup."""
+        st = self._ilv_rows[r]
+        req = st.request
+        first, self.cache = self._ilv_finish(
+            self.cache, self._ilv_mini, jnp.int32(r), jnp.int32(st.slot),
+            jnp.int32(st.n), sel,
+            jnp.asarray([req.seed & 0xFFFFFFFF], np.uint32),
+            jnp.asarray([req.sampling.temperature], np.float32),
+            jnp.asarray([req.sampling.top_k], np.int32),
+            jnp.asarray([req.sampling.top_p], np.float32),
+        )
+        first_tok = int(np.asarray(first)[0])
+        self._ilv_rows[r] = None
+        self._activate_slot(st.slot, req, first_tok)
 
     def _tick_collect_one(self) -> None:
         """Pull the oldest in-flight tick's tokens to the host and emit
@@ -1593,6 +1925,16 @@ class ContinuousBatcher:
         if request.cancelled:
             finished_reason = finished_reason or "cancelled"
             ids = []
+        # Decode-stall accounting: the gap since this slot's previous
+        # emission (admission-induced stalls land here — the histogram
+        # prefill_interleave exists to flatten).
+        now = time.perf_counter()
+        last = self._slot_last_emit[slot_idx]
+        if last is not None:
+            self._stall_records.append((now - last) * 1000.0)
+        self._slot_last_emit[slot_idx] = (
+            None if finished_reason is not None else now
+        )
         if finished_reason is not None:
             # Park the slot BEFORE delivering the terminal chunk: the
             # moment the consumer sees it, the request is observably

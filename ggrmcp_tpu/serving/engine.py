@@ -872,8 +872,15 @@ class GenerationEngine:
             temps = jnp.asarray(
                 np.asarray(temperatures, np.float32)
             )
+            if not seeds:
+                # Distinct per-row defaults: a shared seed-0 default
+                # would make every row of a sampled batch draw the SAME
+                # random stream — "independent" samples correlated
+                # across the batch. None entries inside an explicit
+                # list still mean seed 0 (caller's choice, row-local).
+                seeds = list(range(len(prompts)))
             seed_arr = jnp.asarray(np.asarray(
-                [(s or 0) & 0xFFFFFFFF for s in (seeds or [0] * len(prompts))],
+                [(s or 0) & 0xFFFFFFFF for s in seeds],
                 np.uint32,
             ))
         with self.mesh:

@@ -111,10 +111,18 @@ class TieredBatcher:
         """Total KV-cache HBM across tiers (bench/stats reporting)."""
         return sum(t.cache_bytes() for t in self.tiers)
 
+    def stall_snapshot(self) -> list[float]:
+        """Concatenated per-tier decode-stall samples (same contract
+        as each tier's stall_snapshot — bench/stats reporting)."""
+        records: list = []
+        for t in self.tiers:
+            records.extend(t.stall_snapshot())
+        return records
+
     def stats(self) -> dict:
         """Aggregated ServingStats across tiers: counters sum;
-        queue/service percentiles are computed ONCE over the
-        concatenated per-tier latency records (summing a p50 is
+        queue/service (and decode-stall) percentiles are computed ONCE
+        over the concatenated per-tier records (summing a p50 is
         meaningless, and per-tier percentile sorts would be wasted
         work on every scrape)."""
         per_tier = [t.counter_stats() for t in self.tiers]
@@ -131,6 +139,7 @@ class TieredBatcher:
                 for key in per_tier[0]
             },
             **ContinuousBatcher.lat_percentiles(records),
+            **ContinuousBatcher.stall_percentiles(self.stall_snapshot()),
         }
 
     # Prefix-pool counters aggregate across tiers (each tier owns its

@@ -1,0 +1,32 @@
+"""Version-tolerant JAX surface.
+
+The serving plane is written against the current jax API; images can
+lag (the TPU image bakes a pinned toolchain). Nothing may be installed
+into the container, so API moves are bridged here instead:
+
+- `shard_map` graduated from jax.experimental.shard_map to jax.shard_map,
+  renaming check_rep -> check_vma and adding `axis_names` (partial-manual
+  mode) along the way. On an old jax, axis_names is dropped — full-manual
+  over the whole mesh computes the same values (unnamed axes replicate
+  instead of staying auto-partitioned; duplicated compute, identical
+  outputs) — and check_vma maps back to check_rep.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+
+    _LEGACY = False
+except ImportError:  # pragma: no cover - depends on baked image
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _LEGACY = True
+
+
+def shard_map(f, **kwargs):
+    if _LEGACY:
+        kwargs.pop("axis_names", None)
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)

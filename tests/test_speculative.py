@@ -345,6 +345,26 @@ class TestSampledSpeculative:
             f"(emp {np.round(emp, 3)}, exact {np.round(exact, 3)})"
         )
 
+    def test_default_seeds_are_per_row_distinct(self, nano_engine):
+        """`temperatures` set with `seeds=None` must derive DISTINCT
+        per-row default seeds (the row index), not broadcast seed 0:
+        identical prompts in a sampled batch were coming back as
+        identical "independent" samples (regression for the old
+        `seeds or [0] * len(prompts)` default)."""
+        prompts = [[3, 1, 4]] * 4
+        default, _, _ = nano_engine.generate_speculative(
+            prompts, max_new_tokens=12, temperatures=[1.0] * 4,
+        )
+        explicit, _, _ = nano_engine.generate_speculative(
+            prompts, max_new_tokens=12, temperatures=[1.0] * 4,
+            seeds=[0, 1, 2, 3],
+        )
+        # The default is exactly seeds=range(rows) — deterministic...
+        assert default == explicit
+        # ...and the rows genuinely decorrelate (the seed-0 broadcast
+        # made every row of this batch bit-identical).
+        assert len({tuple(r) for r in default}) > 1
+
     async def test_spec_batcher_mixed_temperatures(self):
         """The micro-batcher coalesces greedy and sampled requests into
         one call; greedy output stays solo-identical and acceptance
