@@ -5,8 +5,8 @@ PROTOC ?= protoc
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: proto descriptors test test-all test-fast bench-cpu smoke e2e lint \
-  ci-local preflight clean
+.PHONY: proto descriptors test test-all test-fast test-chaos bench-cpu \
+  smoke e2e lint ci-local preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
 proto:
@@ -30,6 +30,13 @@ test-all:
 test-fast:
 	$(PY) -m pytest tests/ -q -x --ignore=tests/test_serving.py \
 	  --ignore=tests/test_models.py
+
+# Fault-injection suite alone (CPU mesh): bounded admission, tick-
+# failure replay, failpoint determinism. The chaos marker is NOT slow,
+# so tier-1 (`make test`) runs these too — this target is the fast
+# inner loop when hardening failure paths.
+test-chaos:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m chaos
 
 # CPU smoke of the full bench, including the mixed long-prompt+decode
 # workload phase (interleaved prefill on — A/B the serialized baseline

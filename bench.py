@@ -30,6 +30,11 @@ Environment knobs:
                          mid-decode ride tick-fused chunks) or "off"
                          (serialized fused-grid admission). A/B these
                          to see mixed_decode_stall_p99_ms move.
+  GGRMCP_BENCH_MAX_PENDING  batching.max_pending for the serving stack
+                         (default 0 = unbounded, the comparable-run
+                         default). Nonzero sheds excess load with 429s;
+                         the artifact's shed_requests counter records
+                         how much of the offered load was refused.
   GGRMCP_BENCH_CPU=1     force the CPU platform (tiny model)
 """
 
@@ -365,6 +370,14 @@ async def _run_bench() -> dict:
             prefix_cache_min_seq=48,
             prefix_cache_max_seq=256,
             prefill_interleave=interleave,
+            # Bounded admission (docs/robustness.md): 0 keeps the
+            # default unbounded queue so throughput numbers stay
+            # comparable across rounds; set GGRMCP_BENCH_MAX_PENDING
+            # to measure shed-shaped behavior (the artifact's
+            # shed_requests counter records how much was refused).
+            max_pending=int(
+                os.environ.get("GGRMCP_BENCH_MAX_PENDING", "0")
+            ),
         ),
     )
     sidecar = Sidecar(serving)
@@ -916,6 +929,13 @@ async def _run_bench() -> dict:
             "service_ms_p50": sb.get("service_ms_p50", 0.0),
             "service_ms_p99": sb.get("service_ms_p99", 0.0),
             "timed_out": sb.get("timed_out", 0),
+            # Overload/replay lifecycle counters: nonzero shed means
+            # the run was shaped by bounded admission
+            # (GGRMCP_BENCH_MAX_PENDING) — throughput numbers then
+            # describe the ACCEPTED load, not the offered load.
+            "shed_requests": sb.get("shed_requests", 0),
+            "replayed_requests": sb.get("replayed_requests", 0),
+            "replay_exhausted": sb.get("replay_exhausted", 0),
         }
     except Exception as exc:  # diagnostics must not sink the result
         print(f"bench: tick breakdown failed: {exc!r}", file=sys.stderr)

@@ -345,6 +345,23 @@ class BatchingConfig:
     # is K x kv_cache_max_seq of KV. Further long prompts queue for a
     # free row.
     prefill_interleave_rows: int = 4
+    # Bounded admission / load shedding. max_pending > 0 caps the
+    # number of requests waiting for a slot; max_queue_tokens > 0 caps
+    # the total prompt tokens they hold. A submit() that would exceed
+    # either cap raises OverloadedError instead of queueing (the
+    # sidecar maps it to gRPC RESOURCE_EXHAUSTED, the gateway to HTTP
+    # 429 + Retry-After) — overload becomes controlled shedding with a
+    # bounded queue instead of unbounded growth and deadline-timeout
+    # collapse. 0 = unbounded (the pre-hardening behavior).
+    max_pending: int = 0
+    max_queue_tokens: int = 0
+    # Tick-failure replay: a failed decode tick requeues each victim
+    # with its prompt + already-emitted tokens as a replay prefix (the
+    # consumer never sees duplicates; greedy outputs are bit-identical
+    # to the fault-free run) up to this many times per request. Only
+    # requests that exhaust the budget see finish_reason "error". 0 =
+    # fail every victim immediately (the pre-replay behavior).
+    tick_retry_limit: int = 1
 
 
 # decode_steps_per_tick="auto" resolves to this on TPU meshes: with
@@ -466,6 +483,11 @@ class ServingConfig:
     # qkv projection. Dense Llama, single-stage meshes only (the
     # engine validates); empty adapter list = off.
     lora: "LoraConfig" = field(default_factory=lambda: LoraConfig())
+    # Deterministic fault injection (utils/failpoints.py), e.g.
+    # "tick_fail:every=7,admit_slow:ms=50". Armed at engine init; the
+    # GGRMCP_FAILPOINTS env var arms the same registry at import.
+    # "" = nothing armed. Chaos testing only — never set in production.
+    failpoints: str = ""
 
 
 @dataclass
@@ -592,6 +614,25 @@ class Config:
             )
         if self.serving.batching.prefill_interleave_rows < 1:
             raise ValueError("batching.prefill_interleave_rows must be >= 1")
+        if self.serving.batching.max_pending < 0:
+            raise ValueError("batching.max_pending must be >= 0 (0 = unbounded)")
+        if self.serving.batching.max_queue_tokens < 0:
+            raise ValueError(
+                "batching.max_queue_tokens must be >= 0 (0 = unbounded)"
+            )
+        if self.serving.batching.tick_retry_limit < 0:
+            raise ValueError(
+                "batching.tick_retry_limit must be >= 0 (0 = no replay)"
+            )
+        if self.serving.failpoints:
+            from ggrmcp_tpu.utils.failpoints import parse_spec
+
+            try:
+                parse_spec(self.serving.failpoints)
+            except ValueError as exc:
+                # A chaos config with a typo must fail at parse time,
+                # not silently inject nothing.
+                raise ValueError(f"serving.failpoints: {exc}")
         if self.serving.speculative_gamma < 1:
             raise ValueError("speculative_gamma must be >= 1")
         if self.training.steps < 1 or self.training.batch_size < 1:

@@ -485,6 +485,7 @@ class FastLaneServer:
                     mcp.make_error_response(
                         None, mcp.INVALID_REQUEST, "rate limit exceeded"
                     ),
+                    retry_after_s=1.0,
                 )
             elif method == "POST" and not any(
                 headers.get("content-type", "").startswith(a)
@@ -643,12 +644,15 @@ class FastLaneServer:
         )
         if resp_dict is None and sse is not None and sse.started:
             return 200  # streamed; connection closes after the result
+        retry_after = mcp.overload_retry_after_s(resp_dict)
+        status = 200 if retry_after is None else 429
         self._write_json(
-            conn, headers, 200, resp_dict,
+            conn, headers, status, resp_dict,
             session_id=session.id if session is not None else None,
             trace_id=trace_id,
+            retry_after_s=retry_after,
         )
-        return 200
+        return status
 
     # -- helpers ---------------------------------------------------------
 
@@ -710,6 +714,7 @@ class FastLaneServer:
         payload: Any,
         session_id: Optional[str] = None,
         trace_id: Optional[str] = None,
+        retry_after_s: Optional[float] = None,
     ) -> None:
         body = json.dumps(payload, ensure_ascii=False).encode()
         extra = b""
@@ -717,6 +722,8 @@ class FastLaneServer:
             extra += b"Mcp-Session-Id: " + session_id.encode() + b"\r\n"
         if trace_id is not None:
             extra += b"X-Trace-Id: " + trace_id.encode() + b"\r\n"
+        if retry_after_s is not None:
+            extra += b"Retry-After: %d\r\n" % max(1, int(retry_after_s))
         if status == 200:
             head = self._json_200
         else:

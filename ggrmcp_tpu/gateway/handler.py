@@ -47,6 +47,14 @@ logger = logging.getLogger("ggrmcp.gateway.handler")
 SESSION_HEADER = "Mcp-Session-Id"
 TRACE_RESPONSE_HEADER = "X-Trace-Id"
 
+# What the backend suggests (and the gateway's Retry-After advertises)
+# when a call is shed with RESOURCE_EXHAUSTED.
+OVERLOAD_RETRY_AFTER_S = 1
+# /health reports "degraded" while any backend shed within this window:
+# a scrape between shed bursts must not flap back to "healthy" while
+# the overload is plainly ongoing.
+SHED_DEGRADED_WINDOW_S = 30.0
+
 
 class SSETransport:
     """How `MCPHandler._stream_tool_call` writes an event stream,
@@ -107,6 +115,10 @@ class MCPHandler:
         self.validator = Validator(cfg.mcp.validation)
         self.header_filter = HeaderFilter(cfg.grpc.header_forwarding)
         self.tool_builder = ToolBuilder(cfg.tools, discoverer.comment_fn)
+        # Shed tracking for /health's "degraded" state: the last total
+        # shed count seen across backends and when it last increased.
+        self._shed_seen = 0.0
+        self._shed_last_rise = float("-inf")
 
     # ------------------------------------------------------------------
     # HTTP entry points
@@ -156,7 +168,16 @@ class MCPHandler:
         )
         if resp_dict is None and sse is not None and sse.response is not None:
             return sse.response  # streamed; final event already written
-        response = web.json_response(resp_dict)
+        retry_after = mcp.overload_retry_after_s(resp_dict)
+        if retry_after is not None:
+            # Backend shed the call (bounded admission): HTTP 429 with
+            # a Retry-After so well-behaved clients back off.
+            response = web.json_response(
+                resp_dict, status=429,
+                headers={"Retry-After": str(max(1, int(retry_after)))},
+            )
+        else:
+            response = web.json_response(resp_dict)
         if session is not None:
             response.headers[SESSION_HEADER] = session.id
         if trace_id is not None:
@@ -327,6 +348,24 @@ class MCPHandler:
                 mcp.INVALID_PARAMS, sanitize_error(f"invalid arguments: {exc}")
             )
         except (grpc.RpcError, grpc.aio.UsageError) as exc:
+            if (
+                isinstance(exc, grpc.aio.AioRpcError)
+                and exc.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            ):
+                # The backend SHED this call (bounded admission full) —
+                # overload, not failure. Surface it as a typed JSON-RPC
+                # error the HTTP transports turn into 429 + Retry-After
+                # so clients back off instead of hammering an IsError
+                # result loop.
+                self.metrics.observe_tool_call(
+                    tool_name, "overloaded", time.perf_counter() - start
+                )
+                session.increment_calls()
+                raise mcp.MCPError(
+                    mcp.OVERLOADED,
+                    sanitize_error(f"backend overloaded: {exc.details()}"),
+                    data={"retryAfterS": OVERLOAD_RETRY_AFTER_S},
+                )
             # Backend failure → IsError result, NOT a protocol error
             # (handler.go:252-259 behavior, carried over). UsageError
             # covers invoking over a channel the reconnect watchdog
@@ -441,10 +480,32 @@ class MCPHandler:
     # Health / metrics / stats endpoints
     # ------------------------------------------------------------------
 
+    def _sustained_shed(self, serving_stats: list[dict[str, Any]]) -> bool:
+        """True while any backend shed (RESOURCE_EXHAUSTED / 429)
+        within SHED_DEGRADED_WINDOW_S. Tracks the cross-backend total
+        of the shed_requests counter; protojson renders int64 as
+        strings, hence float()."""
+        total = 0.0
+        for entry in serving_stats:
+            if "error" not in entry:
+                try:
+                    total += float(entry.get("shedRequests", 0))
+                except (TypeError, ValueError):
+                    pass
+        now = time.monotonic()
+        if total > self._shed_seen:
+            self._shed_seen = total
+            self._shed_last_rise = now
+        return now - self._shed_last_rise < SHED_DEGRADED_WINDOW_S
+
     async def health_body(self) -> tuple[dict[str, Any], int]:
         """GET /health core (handler.go:331-364): deep backend check +
-        tool count; 503 when degraded. Framework-free — shared by the
-        aiohttp handler and the fast lane."""
+        tool count; 503 when unhealthy. A healthy stack that is
+        actively SHEDDING (bounded admission refusing work) reports
+        "degraded" at HTTP 200 — still serving, but load balancers and
+        dashboards see the overload before clients collapse into
+        retry storms. Framework-free — shared by the aiohttp handler
+        and the fast lane."""
         try:
             healthy = await asyncio.wait_for(
                 self.discoverer.health_check(), timeout=5.0
@@ -452,14 +513,24 @@ class MCPHandler:
         except asyncio.TimeoutError:
             healthy = False
         stats = self.discoverer.get_service_stats()
+        shedding = self._sustained_shed(
+            await self.discoverer.get_serving_stats_snapshot()
+        )
+        if not (healthy and stats["methodCount"] > 0):
+            status = "unhealthy"
+        elif shedding:
+            status = "degraded"
+        else:
+            status = "healthy"
         body = {
-            "status": "healthy" if healthy and stats["methodCount"] > 0 else "unhealthy",
+            "status": status,
             "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "serviceCount": stats["serviceCount"],
             "methodCount": stats["methodCount"],
             "sessions": self.sessions.count(),
+            "shedding": shedding,
         }
-        return body, 200 if body["status"] == "healthy" else 503
+        return body, 503 if status == "unhealthy" else 200
 
     async def handle_health(self, request: web.Request) -> web.Response:
         body, status = await self.health_body()

@@ -123,8 +123,29 @@ class GatewayMetrics:
                  "median gap between a live slot's token emissions"),
                 ("decode_stall_ms_p99",
                  "p99 gap between a live slot's token emissions"),
+                ("queued_tokens",
+                 "prompt tokens held by queued requests"),
+                ("timed_out",
+                 "requests expired in queue past queue_deadline_ms"),
+                ("shed_requests",
+                 "submits refused by bounded admission (OverloadedError)"),
+                ("replayed_requests",
+                 "requests requeued with a replay prefix after a "
+                 "failed tick"),
+                ("replay_exhausted",
+                 "requests that exhausted tick_retry_limit and errored"),
             ]
         }
+        # The overload early-warning gauge: admission-queue depth per
+        # backend in both units (unit="requests" | "tokens") — watch
+        # this against batching.max_pending / max_queue_tokens to see
+        # shedding thresholds approach BEFORE 429s start.
+        self.batcher_pending_depth = Gauge(
+            "gateway_batcher_pending_depth",
+            "Backend admission-queue depth (unit=requests|tokens)",
+            ["target", "unit"],
+            registry=self.registry,
+        )
         # labels() re-validates and re-hashes label values every call
         # (~6 µs each, ×5 per request); label children are cached here.
         # Cardinality is bounded by tool/method/status counts.
@@ -192,6 +213,11 @@ class GatewayMetrics:
                 # strings and doubles as numbers — float() takes both,
                 # and the millisecond stall gauges carry fractions.
                 self._child(gauge, target).set(float(value))
+            for unit, key in (("requests", "queuedRequests"),
+                              ("tokens", "queuedTokens")):
+                self._child(
+                    self.batcher_pending_depth, target, unit
+                ).set(float(entry.get(key, 0)))
         for target in self._serving_targets - live:
             for gauge in self.serving_gauges.values():
                 try:
@@ -199,6 +225,14 @@ class GatewayMetrics:
                 except KeyError:
                     pass
                 self._children.pop((id(gauge), target), None)
+            for unit in ("requests", "tokens"):
+                try:
+                    self.batcher_pending_depth.remove(target, unit)
+                except KeyError:
+                    pass
+                self._children.pop(
+                    (id(self.batcher_pending_depth), target, unit), None
+                )
         self._serving_targets = live
 
     def render(self) -> tuple[bytes, str]:

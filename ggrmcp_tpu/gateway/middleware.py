@@ -145,6 +145,9 @@ def rate_limit_middleware(cfg: ServerConfig, metrics: GatewayMetrics) -> Callabl
             return web.json_response(
                 mcp.make_error_response(None, mcp.INVALID_REQUEST, "rate limit exceeded"),
                 status=429,
+                # Token bucket refills continuously; 1s is the honest
+                # "try again soon" for a burst-sized dip.
+                headers={"Retry-After": "1"},
             )
         return await handler(request)
 
@@ -257,6 +260,7 @@ def fused_middleware(cfg: ServerConfig, metrics: GatewayMetrics) -> Callable:
                         None, mcp.INVALID_REQUEST, "rate limit exceeded"
                     ),
                     status=429,
+                    headers={"Retry-After": "1"},
                 )
             else:
                 if request.method == "POST" and request.can_read_body:

@@ -18,8 +18,29 @@ INVALID_REQUEST = -32600
 METHOD_NOT_FOUND = -32601
 INVALID_PARAMS = -32602
 INTERNAL_ERROR = -32603
+# Implementation-defined server-error range (-32000..-32099): the
+# serving backend shed this request (bounded admission full). The HTTP
+# transports map this code to 429 + Retry-After; the error's `data`
+# carries {"retryAfterS": n} for JSON-RPC-level clients.
+OVERLOADED = -32029
 
 JSONRPC_VERSION = "2.0"
+
+
+def overload_retry_after_s(response: Any) -> Optional[float]:
+    """Seconds-to-retry if `response` is an OVERLOADED JSON-RPC error
+    dict, else None — the one place transports decide '429 or not'."""
+    if not isinstance(response, dict):
+        return None
+    error = response.get("error")
+    if not isinstance(error, dict) or error.get("code") != OVERLOADED:
+        return None
+    data = error.get("data")
+    retry = data.get("retryAfterS", 1) if isinstance(data, dict) else 1
+    try:
+        return max(0.0, float(retry))
+    except (TypeError, ValueError):
+        return 1.0
 
 # A request ID is a string or a number (never null on requests).
 RequestID = Union[str, int, float]

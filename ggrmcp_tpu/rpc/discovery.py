@@ -26,6 +26,7 @@ from ggrmcp_tpu.core.types import MethodInfo
 from ggrmcp_tpu.rpc.connection import ChannelManager
 from ggrmcp_tpu.rpc.descriptors import CommentIndex, DescriptorSetLoader
 from ggrmcp_tpu.rpc.reflection_client import DynamicInvoker, ReflectionClient
+from ggrmcp_tpu.utils import failpoints
 
 logger = logging.getLogger("ggrmcp.rpc.discovery")
 
@@ -246,7 +247,14 @@ class ServiceDiscoverer:
             self._serving_stats_task.cancel()
             try:
                 await self._serving_stats_task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError:
+                # Expected when it is the TASK's cancellation (ours,
+                # one line up). If the task did NOT end cancelled, the
+                # CancelledError was aimed at close() itself — swallow
+                # it and a cancelled shutdown wedges half-closed.
+                if not self._serving_stats_task.cancelled():
+                    raise
+            except Exception:  # noqa: BLE001 — refresh errors only
                 pass
             self._serving_stats_task = None
         await asyncio.gather(
@@ -293,6 +301,10 @@ class ServiceDiscoverer:
     async def _try_reconnect(self, backend: Backend) -> bool:
         for attempt in range(self.cfg.reconnect.max_attempts):
             try:
+                # Chaos hook (utils/failpoints.py): an injected fault
+                # here is a dial that failed — it burns an attempt and
+                # takes the same backoff as a real connect error.
+                failpoints.evaluate("reconnect_fail")
                 await backend.connect()
                 return True
             except Exception as exc:

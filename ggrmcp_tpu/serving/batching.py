@@ -31,9 +31,80 @@ from ggrmcp_tpu.models import llama as llama_mod
 from ggrmcp_tpu.ops import quant
 from ggrmcp_tpu.ops.sampling import SamplingConfig, sample_dynamic
 from ggrmcp_tpu.serving.engine import bucket_len, fit_request
+from ggrmcp_tpu.utils import failpoints
 from ggrmcp_tpu.utils.stats import nearest_rank
 
 logger = logging.getLogger("ggrmcp.serving.batching")
+
+
+class OverloadedError(RuntimeError):
+    """submit() refused a request because the admission queue is at its
+    configured cap (batching.max_pending / max_queue_tokens). The
+    sidecar maps this to gRPC RESOURCE_EXHAUSTED and the gateway to
+    HTTP 429 with Retry-After — shedding at the front door is the
+    overload contract; the queue never grows without bound."""
+
+    def __init__(self, message: str, reason: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.reason = reason  # "requests" | "tokens"
+        self.retry_after_s = retry_after_s
+
+
+class _PendingQueue:
+    """Admission queue with request- and token-depth accounting.
+
+    asyncio.Queue can neither report queued prompt tokens (the
+    max_queue_tokens cap and the queued_tokens gauge), sweep expired
+    entries, nor requeue a tick-failure victim at the FRONT — so the
+    pending queue is a deque owned by this class. Single async
+    consumer (the batcher loop); every method is event-loop-thread
+    only, like the rest of the batcher's host state."""
+
+    def __init__(self) -> None:
+        self._items: deque = deque()
+        self._tokens = 0
+        self._event = asyncio.Event()
+
+    def put_nowait(self, request: "_Request") -> None:
+        self._items.append(request)
+        self._tokens += len(request.prompt)
+        self._event.set()
+
+    def requeue_front(self, request: "_Request") -> None:
+        """Head-of-queue insert for replayed requests: they were
+        already admitted once and must not wait behind the backlog
+        (or shed — replays bypass the caps by design)."""
+        self._items.appendleft(request)
+        self._tokens += len(request.prompt)
+        self._event.set()
+
+    def _pop(self) -> "_Request":
+        request = self._items.popleft()
+        self._tokens -= len(request.prompt)
+        return request
+
+    def get_nowait(self) -> "_Request":
+        if not self._items:
+            raise asyncio.QueueEmpty
+        return self._pop()
+
+    async def get(self) -> "_Request":
+        # Single-consumer wait: no await between the emptiness check
+        # and clear(), so a concurrent put's set() cannot be lost.
+        while not self._items:
+            self._event.clear()
+            await self._event.wait()
+        return self._pop()
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def empty(self) -> bool:
+        return not self._items
+
+    @property
+    def token_count(self) -> int:
+        return self._tokens
 
 
 def _merge_row(cache, mini, slot, length):
@@ -92,9 +163,18 @@ class _Request:
     # a cross-thread call_soon_threadsafe + queue put + consumer wakeup
     # per slot per tick — at batch 16 that is 16x the loop events the
     # result needs. Tokens accumulate in `acc` (executor-thread-only
-    # until the terminal emit) and post once on finish.
+    # until the terminal emit) and post once on finish. `acc` holds
+    # EVERY emitted token for streaming consumers too: it is the
+    # replay prefix after a tick failure (the re-admission prefills
+    # prompt + acc, so the consumer never sees a duplicate token).
     unary: bool = False
     acc: list[int] = dataclasses.field(default_factory=list)
+    # Tick-failure replay bookkeeping: retries burned against
+    # batching.tick_retry_limit, and how many acc tokens have already
+    # been folded into `prompt` by previous replays (a second failure
+    # must only absorb the tokens emitted since the first).
+    retries: int = 0
+    absorbed: int = 0
     # LoRA adapter row id (0 = base model; ops/lora.py).
     adapter: int = 0
     # Latency accounting (perf_counter seconds): submit → activation
@@ -121,7 +201,9 @@ class ContinuousBatcher:
         self.cfg = cfg or BatchingConfig()
         self.eos_id = eos_id
         self.slots = [_Slot() for _ in range(self.cfg.max_batch_size)]
-        self.pending: asyncio.Queue[_Request] = asyncio.Queue()
+        # Bounded admission queue (batching.max_pending /
+        # max_queue_tokens caps enforced in submit()).
+        self.pending = _PendingQueue()
         # True while a call that donates the SHARED cache is in flight
         # (set just before, cleared after self.cache is reassigned);
         # admission-failure handling rebuilds the cache only when set.
@@ -255,6 +337,13 @@ class ContinuousBatcher:
         # an SLO config stays small until measured).
         self._admit_ema_ms = 50.0
         self.timed_out = 0
+        # Overload / replay accounting: requests refused at submit()
+        # (OverloadedError), requests requeued with a replay prefix
+        # after a failed tick, and requests that exhausted the
+        # tick_retry_limit budget and surfaced "error".
+        self.shed = 0
+        self.replayed = 0
+        self.replay_exhausted = 0
 
         # jitted: one decode tick for the whole slot pool (params ride
         # as an argument — a closed-over weight tree would be lowered
@@ -1168,10 +1257,16 @@ class ContinuousBatcher:
         (see _Request.unary). `adapter`: LoRA adapter row id (0 = base;
         resolve names via engine.resolve_adapter).
 
-        Validation runs HERE, eagerly, not at first iteration of the
-        returned generator — a caller that enqueues several requests
-        before consuming any sees the bad-argument error at the call
-        site."""
+        Validation, the admission-cap check, and the enqueue all run
+        HERE, eagerly, not at first iteration of the returned
+        generator: a caller that enqueues several requests before
+        consuming any sees bad-argument errors AND OverloadedError at
+        the call site — and the caps, the queued_tokens gauge, and the
+        queue-deadline clock all agree on when a request starts
+        occupying bounded queue capacity.
+
+        Raises OverloadedError (load shedding) when batching.max_pending
+        or max_queue_tokens would be exceeded."""
         # Range-check the adapter row (names resolve upstream):
         # jnp.take clips out-of-range gathers, which would silently
         # serve the WRONG adapter's factors.
@@ -1187,24 +1282,39 @@ class ContinuousBatcher:
         prompt, max_new = fit_request(
             prompt, max_new, self._fit_limit - self._reserve
         )
+        cap = self.cfg.max_pending
+        if cap > 0 and self.pending.qsize() >= cap:
+            self.shed += 1
+            raise OverloadedError(
+                f"admission queue full ({cap} requests pending)",
+                reason="requests",
+            )
+        tcap = self.cfg.max_queue_tokens
+        if (
+            tcap > 0 and not self.pending.empty()
+            and self.pending.token_count + len(prompt) > tcap
+        ):
+            # The non-empty guard keeps a single prompt longer than
+            # the whole cap admissible on an idle queue: a
+            # misconfigured cap must degrade to FIFO, not to a
+            # permanent 429 for every large request.
+            self.shed += 1
+            raise OverloadedError(
+                f"admission queue token budget full ({tcap} tokens)",
+                reason="tokens",
+            )
         request = _Request(
             prompt=prompt, max_new=max_new, sampling=sampling, seed=seed,
             unary=unary, adapter=adapter,
         )
+        request.t_submit = time.perf_counter()
+        self.pending.put_nowait(request)
+        self._wake.set()
         return self._consume(request)
 
     async def _consume(
         self, request: _Request
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
-        # The queue clock (queue_deadline_ms, queue_ms accounting)
-        # starts when the request actually enters `pending` — NOT at
-        # submit(): generators run lazily, so a caller that builds
-        # several iterators before consuming any would otherwise burn
-        # deadline on its own scheduling. Validation stays eager in
-        # submit() (bad arguments still fail at the call site).
-        request.t_submit = time.perf_counter()
-        await self.pending.put(request)
-        self._wake.set()
         try:
             while True:
                 ids, reason = await request.out.get()
@@ -1296,6 +1406,15 @@ class ContinuousBatcher:
             "prefix_cache_misses": self.prefix_misses,
             "decode_steps": self.step_counter,
             "timed_out": self.timed_out,
+            # Pending-depth gauges + overload/replay counters: queue
+            # depth in prompt tokens (queued_requests above is the
+            # depth in requests), submits shed with OverloadedError,
+            # tick-failure replays, and replays that exhausted
+            # tick_retry_limit and surfaced "error".
+            "queued_tokens": self.pending.token_count,
+            "shed_requests": self.shed,
+            "replayed_requests": self.replayed,
+            "replay_exhausted": self.replay_exhausted,
             # Interleaved (tick-fused) admission activity: chunks
             # piggybacked onto decode ticks / requests admitted that way.
             "interleaved_chunks": self.interleaved_chunks,
@@ -1352,7 +1471,7 @@ class ContinuousBatcher:
                         )
                     except Exception:
                         logger.exception("in-flight tick drain failed")
-                        self._reset_after_tick_failure()
+                        self._recover_after_tick_failure()
                     continue
                 # Clear BEFORE checking pending: a submit() landing after
                 # the check still leaves its set() visible to wait(),
@@ -1366,33 +1485,80 @@ class ContinuousBatcher:
             try:
                 await loop.run_in_executor(None, self._tick_step)
             except Exception:
-                # Fail every active request rather than dying silently;
-                # the loop stays alive for future submissions.
-                logger.exception("decode tick failed; failing active slots")
-                self._reset_after_tick_failure()
+                # Replay every victim with budget left rather than
+                # failing the whole pool for one transient fault; the
+                # loop stays alive for future submissions either way.
+                logger.exception("decode tick failed; replaying active slots")
+                self._recover_after_tick_failure()
             await asyncio.sleep(0)  # let handlers drain queues
 
     def _drain_inflight(self) -> None:
         while self._inflight:
             self._tick_collect_one()
 
-    def _reset_after_tick_failure(self) -> None:
+    def _replay_or_fail(self, request: _Request) -> None:
+        """One victim of a failed device call. With retry budget left,
+        requeue it at the head of the admission queue with its emitted
+        tokens folded into the prompt — the re-admission prefill
+        resumes EXACTLY where the consumer last saw a token (no
+        duplicates, and a greedy continuation of prompt + emitted is
+        bit-identical to the uninterrupted run, which is what the
+        chaos suite asserts). Only budget exhaustion — a fault that
+        recurs tick_retry_limit+1 times, i.e. likely deterministic —
+        surfaces finish_reason "error"."""
+        if request.cancelled:
+            # The consumer is gone; freeing the slot is the recovery.
+            self._loop_ref.call_soon_threadsafe(
+                request.out.put_nowait, ([], "cancelled")
+            )
+            return
+        if request.retries >= self.cfg.tick_retry_limit:
+            self.replay_exhausted += 1
+            self._loop_ref.call_soon_threadsafe(
+                request.out.put_nowait, ([], "error")
+            )
+            return
+        request.retries += 1
+        self.replayed += 1
+        # Fold only the tokens emitted SINCE the last replay into the
+        # prompt (request.absorbed tracks the fold point) and return
+        # their budget: prompt' + max_new' keeps the same total, so
+        # the original fit_request bound still holds.
+        fresh = request.acc[request.absorbed:]
+        if fresh:
+            request.prompt = list(request.prompt) + [int(t) for t in fresh]
+            request.max_new -= len(fresh)
+            request.absorbed = len(request.acc)
+        # Fresh queue clock: a replay must not inherit the original
+        # wait and get swept by queue_deadline_ms after the system
+        # already streamed it tokens.
+        request.t_submit = time.perf_counter()
+        self.pending.requeue_front(request)
+        self._wake.set()
+
+    def _recover_after_tick_failure(self) -> None:
+        """Tick-failure recovery. The failed call donated the shared
+        cache (and any interleave mini), so device state is gone — but
+        the host still knows every victim's prompt and emitted tokens:
+        instead of erroring the whole pool, each victim re-enters the
+        queue through _replay_or_fail with its replay prefix. A
+        transient device fault then costs one re-prefill per victim,
+        not every in-flight request."""
         for slot in self.slots:
             if slot.active and slot.request is not None:
-                self._loop_ref.call_soon_threadsafe(
-                    slot.request.out.put_nowait, ([], "error")
-                )
+                self._replay_or_fail(slot.request)
             slot.active = False
             slot.request = None
             slot.done = False
             slot.reserved = False
-        # In-flight interleaved admissions die with the tick: the fused
-        # call donated their mini cache alongside the shared one.
+        # In-flight interleaved admissions die with the tick (the fused
+        # call donated their mini cache alongside the shared one); they
+        # have emitted nothing yet, so their replay prefix is the plain
+        # prompt — but the requeue still burns a retry, or a prompt
+        # that poisons the fused call would requeue forever.
         for st in list(self._ilv_rows) + list(self._ilv_pending):
             if st is not None:
-                self._loop_ref.call_soon_threadsafe(
-                    st.request.out.put_nowait, ([], "error")
-                )
+                self._replay_or_fail(st.request)
         self._ilv_rows = [None] * self._ilv_k
         self._ilv_pending.clear()
         self._ilv_mini = None
@@ -1408,12 +1574,39 @@ class ContinuousBatcher:
             len(self.slots), self.max_seq
         )
 
+    def _sweep_expired_pending(self) -> None:
+        """Deadline-aware sweep: drop already-expired (and abandoned)
+        queued requests BEFORE admission. Runs every loop turn, free
+        slot or not — under a saturated pool the backlog expires in
+        the queue instead of each entry burning an admission slot and
+        a prefill only to die at its consumer's long-gone deadline."""
+        ddl = self.cfg.queue_deadline_ms
+        if ddl <= 0 or self.pending.empty():
+            return
+        now = time.perf_counter()
+        keep: list[_Request] = []
+        while True:
+            try:
+                request = self.pending.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if request.cancelled:
+                continue  # consumer gone; just release the queue slot
+            if (now - request.t_submit) * 1000.0 > ddl:
+                self.timed_out += 1
+                request.out.put_nowait(([], "timeout"))
+            else:
+                keep.append(request)
+        for request in keep:  # full drain + re-put preserves FIFO order
+            self.pending.put_nowait(request)
+
     async def _admit(self) -> int:
         """Admit pending requests into free slots. Pending requests are
         drained into one batch per round (capped at the free slots);
         a burst costs ONE device call (fused prefill+sample+merge via
         the full-pool program), a trickle of ≤2 uses the cheaper
         single-row program."""
+        self._sweep_expired_pending()
         admitted = 0
         deadline = time.monotonic() + self.cfg.max_queue_delay_ms / 1000.0
         loop = asyncio.get_running_loop()
@@ -1499,15 +1692,18 @@ class ContinuousBatcher:
                 if cache_dead:
                     # The donated buffers are dead: every active slot's
                     # KV rows go with them (anything less would stream
-                    # garbage from a zeroed cache).
+                    # garbage from a zeroed cache). The failing batch
+                    # itself got "error" above — it may be the poison —
+                    # but the bystanders it killed are innocent:
+                    # replay them with their emitted prefix instead of
+                    # turning one bad admission into a full-pool outage.
                     for slot in self.slots:
                         if slot.active and slot.request is not None:
-                            self._loop_ref.call_soon_threadsafe(
-                                slot.request.out.put_nowait, ([], "error")
-                            )
+                            self._replay_or_fail(slot.request)
                         slot.active = False
                         slot.request = None
                         slot.done = False
+                    self._slot_last_emit = [None] * len(self.slots)
                     self.cache = self.engine.make_cache(
                         len(self.slots), self.max_seq
                     )
@@ -1526,6 +1722,11 @@ class ContinuousBatcher:
         (_admit_chunked_group). Only a prefix hit whose suffix needs a
         multi-step bridge plan (rare: pooled prefix + suffix longer
         than prefill_chunk) falls back to the serial per-row path."""
+        # Chaos hooks: admission latency (admit_slow, arm with ms=) and
+        # admission failure (admit_fail) — the latter exercises
+        # _admit's blast-radius-scaled batch-failure handling.
+        failpoints.evaluate("admit_slow")
+        failpoints.evaluate("admit_fail")
         t0 = time.perf_counter()
         fused_slots: list[int] = []
         fused_batch: list[_Request] = []
@@ -1760,6 +1961,10 @@ class ContinuousBatcher:
         — the classic loop; pipelined mode leaves it in flight and
         collects the PREVIOUS one, so the host pull of tick N overlaps
         tick N+1's compute."""
+        # Chaos hook: an injected fault here is indistinguishable from
+        # a real device failure at tick dispatch — _loop's handler
+        # replays the victims (utils/failpoints.py).
+        failpoints.evaluate("tick_fail")
         if self._ilv_busy():
             self._tick_dispatch_chunk()
         else:
@@ -1869,7 +2074,7 @@ class ContinuousBatcher:
         shared cache, sample the first token from `sel[r]`, activate
         the held slot. The int() materialization forces any async
         device failure to surface HERE, inside _tick_step's try, where
-        _reset_after_tick_failure owns the cleanup."""
+        _recover_after_tick_failure owns the cleanup."""
         st = self._ilv_rows[r]
         req = st.request
         first, self.cache = self._ilv_finish(
@@ -1950,8 +2155,11 @@ class ContinuousBatcher:
             # (cache row stays, masked by length on reuse).
             self.temps[slot_idx] = 0.0
             self.adapter_ids[slot_idx] = 0
+        # Every delivered token also lands in `acc`: for unary
+        # consumers it is the terminal payload; for ALL consumers it
+        # is the replay prefix a tick failure resumes from.
+        request.acc.extend(ids)
         if request.unary:
-            request.acc.extend(ids)
             if finished_reason is not None:
                 self._loop_ref.call_soon_threadsafe(
                     request.out.put_nowait,

@@ -114,6 +114,16 @@ class GenerationEngine:
         self.cfg = cfg
         self.fam = family_module(cfg)
         self.serving = serving or ServingConfig()
+        if self.serving.failpoints:
+            # Deterministic fault injection (utils/failpoints.py):
+            # config-armed here, at the serving plane's root, so every
+            # entry point — sidecar, bench, a test-built engine — gets
+            # the same chaos schedule without extra wiring. (The
+            # GGRMCP_FAILPOINTS env var arms the same registry at
+            # import time.)
+            from ggrmcp_tpu.utils import failpoints
+
+            failpoints.registry.arm_spec(self.serving.failpoints)
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh(
             self.serving.mesh
         )

@@ -33,7 +33,7 @@ from ggrmcp_tpu.rpc.server_utils import (
     add_service,
 )
 from ggrmcp_tpu.serving import tensors
-from ggrmcp_tpu.serving.batching import ContinuousBatcher
+from ggrmcp_tpu.serving.batching import ContinuousBatcher, OverloadedError
 from ggrmcp_tpu.serving.engine import EmbeddingEngine, GenerationEngine
 from ggrmcp_tpu.serving.tokenizer import load_tokenizer
 from ggrmcp_tpu.utils import tracing
@@ -239,10 +239,20 @@ class Sidecar:
             else:
                 # unary: one terminal chunk — skips per-tick
                 # cross-thread emission (batching.py _Request.unary).
-                async for chunk_ids, reason in self.batcher.submit(
-                    prompt, max_new, sampling, seed, unary=True,
-                    adapter=adapter,
-                ):
+                try:
+                    it = self.batcher.submit(
+                        prompt, max_new, sampling, seed, unary=True,
+                        adapter=adapter,
+                    )
+                except OverloadedError as exc:
+                    # Load shedding, not failure: RESOURCE_EXHAUSTED is
+                    # the retryable-overload status (the gateway maps
+                    # it to HTTP 429 + Retry-After).
+                    await context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        f"server overloaded ({exc.reason}): {exc}",
+                    )
+                async for chunk_ids, reason in it:
                     token_ids.extend(chunk_ids)
                     if reason:
                         finish = reason
@@ -287,9 +297,19 @@ class Sidecar:
                 return "", stop_hit  # stop cut before emitted point
             return stable[len(emitted):], stop_hit
 
-        async for chunk_ids, reason in self.batcher.submit(
-            prompt, max_new, self._sampling(request), seed, adapter=adapter
-        ):
+        try:
+            it = self.batcher.submit(
+                prompt, max_new, self._sampling(request), seed,
+                adapter=adapter,
+            )
+        except OverloadedError as exc:
+            # Shed before any chunk is written — same overload contract
+            # as unary Generate.
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"server overloaded ({exc.reason}): {exc}",
+            )
+        async for chunk_ids, reason in it:
             all_ids.extend(chunk_ids)
             final = reason is not None
             delta, stop_hit = delta_for(final)

@@ -30,7 +30,7 @@ from typing import AsyncIterator, Optional
 
 from ggrmcp_tpu.core.config import BatchingConfig
 from ggrmcp_tpu.ops.sampling import SamplingConfig
-from ggrmcp_tpu.serving.batching import ContinuousBatcher
+from ggrmcp_tpu.serving.batching import ContinuousBatcher, OverloadedError
 
 logger = logging.getLogger("ggrmcp.serving.tiered")
 
@@ -68,17 +68,28 @@ class TieredBatcher:
             [(t.max_seq, len(t.slots)) for t in self.tiers],
         )
 
+    def _route_tiers(
+        self, prompt_len: int, max_new: int
+    ) -> list[ContinuousBatcher]:
+        """Tiers whose cache fits the request (incl. the tick-overshoot
+        reserve the batcher subtracts in submit — tier._reserve, which
+        doubles under pipelined ticks; routing on anything smaller
+        silently truncates max_new in a tier whose bigger sibling
+        would have served the request in full), smallest first.
+        submit() prefers the head and OVERFLOWS down the list when a
+        tier's bounded admission queue sheds — a full small tier spills
+        into its larger siblings' headroom before the facade 429s."""
+        fits = [
+            tier for tier in self.tiers
+            if prompt_len + max_new + 1 + tier._reserve <= tier.max_seq
+        ]
+        # Oversized requests: the largest pool's clamp policy applies.
+        return fits or [self.tiers[-1]]
+
     def _route(self, prompt_len: int, max_new: int) -> ContinuousBatcher:
-        """Smallest tier whose cache fits the request (incl. the
-        tick-overshoot reserve the batcher subtracts in submit —
-        tier._reserve, which doubles under pipelined ticks; routing on
-        anything smaller silently truncates max_new in a tier whose
-        bigger sibling would have served the request in full)."""
-        for tier in self.tiers:
-            need = prompt_len + max_new + 1 + tier._reserve
-            if need <= tier.max_seq:
-                return tier
-        return self.tiers[-1]  # clamp policy of the largest pool applies
+        """Smallest tier whose cache fits the request — the preferred
+        target before any overflow-on-shed consideration."""
+        return self._route_tiers(prompt_len, max_new)[0]
 
     # -- ContinuousBatcher interface ---------------------------------------
 
@@ -103,9 +114,30 @@ class TieredBatcher:
         unary: bool = False,
         adapter: int = 0,
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
-        return self._route(len(prompt), max_new).submit(
-            prompt, max_new, sampling, seed, unary=unary, adapter=adapter
-        )
+        last_exc: Optional[OverloadedError] = None
+        probed: list[ContinuousBatcher] = []
+        for tier in self._route_tiers(len(prompt), max_new):
+            try:
+                it = tier.submit(
+                    prompt, max_new, sampling, seed, unary=unary,
+                    adapter=adapter,
+                )
+            except OverloadedError as exc:
+                last_exc = exc
+                probed.append(tier)
+                continue
+            # Overflow probes that a larger sibling absorbed are not
+            # caller-visible sheds: un-count them so the aggregated
+            # shed_requests equals requests actually refused.
+            for tier in probed:
+                tier.shed -= 1
+            return it
+        # Every fitting tier is at its admission cap: shed for real —
+        # ONE refusal for the caller, so keep exactly one count.
+        assert last_exc is not None
+        for tier in probed[:-1]:
+            tier.shed -= 1
+        raise last_exc
 
     def cache_bytes(self) -> int:
         """Total KV-cache HBM across tiers (bench/stats reporting)."""

@@ -22,6 +22,10 @@ from ggrmcp_tpu.rpc.server_utils import (
 )
 
 MAGIC_ERROR_USER = "error-user"  # magic input → backend INTERNAL error
+# magic input → RESOURCE_EXHAUSTED, the status a TPU sidecar sheds with
+# when bounded admission is full (serving/sidecar.py) — lets gateway
+# tests exercise the 429/Retry-After overload mapping without a sidecar.
+MAGIC_OVERLOAD_USER = "overload-user"
 
 
 async def _say_hello(request: hello_pb2.HelloRequest, context):
@@ -32,6 +36,11 @@ async def _say_hello(request: hello_pb2.HelloRequest, context):
 async def _get_profile(request: complex_pb2.GetProfileRequest, context):
     if request.user_id == MAGIC_ERROR_USER:
         await context.abort(grpc.StatusCode.INTERNAL, "backend exploded")
+    if request.user_id == MAGIC_OVERLOAD_USER:
+        await context.abort(
+            grpc.StatusCode.RESOURCE_EXHAUSTED,
+            "admission queue full (shed for test)",
+        )
     profile = complex_pb2.Profile(
         user_id=request.user_id,
         display_name=f"User {request.user_id}",

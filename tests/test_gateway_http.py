@@ -10,7 +10,11 @@ import pytest
 
 from ggrmcp_tpu.core import config as cfgmod
 from ggrmcp_tpu.gateway.app import Gateway
-from tests.backend_utils import MAGIC_ERROR_USER, InProcessBackend
+from tests.backend_utils import (
+    MAGIC_ERROR_USER,
+    MAGIC_OVERLOAD_USER,
+    InProcessBackend,
+)
 
 SESSION_HEADER = "Mcp-Session-Id"
 
@@ -160,6 +164,26 @@ class TestToolsCall:
             result = data["result"]
             assert result["isError"] is True
             assert "backend exploded" in result["content"][0]["text"]
+
+    async def test_backend_overload_maps_to_429_retry_after(self):
+        """RESOURCE_EXHAUSTED from a backend (bounded-admission shed on
+        a TPU sidecar) must surface as HTTP 429 + Retry-After with the
+        typed OVERLOADED JSON-RPC error — not as an IsError result a
+        client would retry without backoff."""
+        async with gateway_env() as (_, _gw, client):
+            resp = await rpc(
+                client, "tools/call",
+                {
+                    "name": "complexdemo_profileservice_getprofile",
+                    "arguments": {"userId": MAGIC_OVERLOAD_USER},
+                },
+            )
+            data = await resp.json()
+            assert resp.status == 429
+            assert resp.headers["Retry-After"] == "1"
+            assert data["error"]["code"] == -32029
+            assert "overloaded" in data["error"]["message"]
+            assert data["error"]["data"]["retryAfterS"] == 1
 
     async def test_invalid_arguments_is_invalid_params(self):
         async with gateway_env() as (_, _gw, client):
