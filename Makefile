@@ -5,10 +5,13 @@ PROTOC ?= protoc
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: proto descriptors test test-all test-fast test-chaos bench-cpu \
-  smoke e2e lint ci-local preflight clean
+.PHONY: proto descriptors test test-all test-fast test-chaos test-obs \
+  bench-cpu smoke e2e lint ci-local preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
+# No protoc on this image? scripts/regen_serving_pb2.py regenerates
+# serving_pb2.py from protos/serving.proto in pure Python (and its
+# --check mode runs in the obs test suite, so drift is a red test).
 proto:
 	$(PROTOC) -Iprotos --python_out=ggrmcp_tpu/rpc/pb protos/*.proto
 
@@ -37,6 +40,14 @@ test-fast:
 # inner loop when hardening failure paths.
 test-chaos:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m chaos
+
+# Observability net alone (CPU mesh): tracing, flight recorder, debug
+# endpoints, Prometheus exposition validity (parsed with
+# prometheus_client.parser so malformed series never ship), and the
+# proto↔metrics / proto↔pb2 drift guards. Tier-1 runs these too; this
+# target is the fast inner loop when touching metrics/tracing.
+test-obs:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m obs
 
 # CPU smoke of the full bench, including the mixed long-prompt+decode
 # workload phase (interleaved prefill on — A/B the serialized baseline

@@ -35,6 +35,11 @@ Environment knobs:
                          default). Nonzero sheds excess load with 429s;
                          the artifact's shed_requests counter records
                          how much of the offered load was refused.
+  GGRMCP_BENCH_OBS       serving.observability.enabled: "on" (default —
+                         flight recorder + latency histograms live, the
+                         production configuration) or "off" (A/B the
+                         recorder's overhead; the ttft_ms_* extras are
+                         then absent from the artifact).
   GGRMCP_BENCH_CPU=1     force the CPU platform (tiny model)
 """
 
@@ -49,11 +54,12 @@ import tempfile
 import threading
 import time
 
-# Pure-python percentile helper (no jax import — safe for the isolated
+# Pure-python percentile helpers (no jax import — safe for the isolated
 # proxy phase): the ceil-based nearest-rank formula shared with
-# ContinuousBatcher.lat_percentiles. The previous hand-rolled
-# `int(n*p)-1` read ~p98 at n=63 and indexed -1 at n<2.
-from ggrmcp_tpu.utils.stats import nearest_rank
+# ContinuousBatcher.lat_percentiles (pct = the rounded reporting
+# wrapper). The previous hand-rolled `int(n*p)-1` read ~p98 at n=63 and
+# indexed -1 at n<2.
+from ggrmcp_tpu.utils.stats import nearest_rank, pct
 
 _OWNER_LOCK = threading.Lock()
 _OWNER = {"owner": None}
@@ -349,8 +355,12 @@ async def _run_bench() -> dict:
     # front of every active slot. The mixed phase reports the resulting
     # decode-stall percentiles; "off" A/Bs the serialized baseline.
     interleave = os.environ.get("GGRMCP_BENCH_INTERLEAVE", "on")
+    from ggrmcp_tpu.core.config import ObservabilityConfig
+
+    obs_on = os.environ.get("GGRMCP_BENCH_OBS", "on") != "off"
     serving = ServingConfig(
         model=model,
+        observability=ObservabilityConfig(enabled=obs_on),
         quantize=quantize,
         kv_cache_dtype=kv_dtype,
         synthetic_weights=synth,
@@ -937,6 +947,24 @@ async def _run_bench() -> dict:
             "replayed_requests": sb.get("replayed_requests", 0),
             "replay_exhausted": sb.get("replay_exhausted", 0),
         }
+        # TTFT / queue-wait distributions from the flight recorder's
+        # request records (serving/flight_recorder.py): the end-to-end
+        # attribution the headline p50 can't show — how long calls
+        # waited for a slot vs how fast the first token came back once
+        # admitted. Covers every phase's requests (ring-bounded).
+        _, recs = sidecar.batcher.flight_snapshot(
+            max_ticks=1, max_requests=4096
+        )
+        ttfts = [r.ttft_ms for r in recs if r.ttft_ms > 0]
+        queues = [r.queue_ms for r in recs if r.first_tick >= 0]
+        if ttfts:
+            ticktime["ttft_ms_p50"] = pct(ttfts, 0.5)
+            ticktime["ttft_ms_p99"] = pct(ttfts, 0.99)
+        if queues:
+            # Record-sourced (same window as ttft), overriding the
+            # stats() snapshot percentiles read above.
+            ticktime["queue_ms_p50"] = pct(queues, 0.5)
+            ticktime["queue_ms_p99"] = pct(queues, 0.99)
     except Exception as exc:  # diagnostics must not sink the result
         print(f"bench: tick breakdown failed: {exc!r}", file=sys.stderr)
 

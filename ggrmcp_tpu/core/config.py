@@ -409,6 +409,40 @@ class TrainingConfig:
 QUANTIZE_MODES = ("", "int8")
 
 
+# Default latency-histogram bucket upper bounds (ms), log-spaced 1-2-5
+# over 1 ms .. 60 s: FIXED bounds are what make the exported
+# _bucket/_sum/_count series aggregatable across backends and
+# re-windowable in PromQL (per-process adaptive bounds cannot merge).
+# One list shared by ttft/e2e/queue/tick-duration so a dashboard can
+# overlay them.
+LATENCY_BUCKET_BOUNDS_MS = [
+    1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0,
+]
+
+
+@dataclass
+class ObservabilityConfig:
+    """Engine flight recorder + latency attribution
+    (serving/flight_recorder.py): bounded rings of per-tick and
+    per-request lifecycle records and fixed-bucket latency histograms,
+    exported through ServingStats (gateway /metrics as true Prometheus
+    histograms) and the DebugService.GetFlightRecord RPC (gateway
+    /debug/ticks, /debug/requests). Disabled, every hook is one
+    attribute check — near-zero overhead."""
+
+    enabled: bool = True
+    # Ring capacities: ticks are recorded per decode tick (512 ≈ the
+    # last few seconds under load), requests per terminal chunk.
+    tick_ring: int = 512
+    request_ring: int = 2048
+    # Histogram bucket upper bounds (ms), strictly ascending. Values
+    # above the last bound land in an overflow bucket (+Inf).
+    bucket_bounds_ms: list = field(
+        default_factory=lambda: list(LATENCY_BUCKET_BOUNDS_MS)
+    )
+
+
 @dataclass
 class ServingConfig:
     model: str = "tiny-llama"  # registry key in ggrmcp_tpu.models
@@ -488,6 +522,11 @@ class ServingConfig:
     # GGRMCP_FAILPOINTS env var arms the same registry at import.
     # "" = nothing armed. Chaos testing only — never set in production.
     failpoints: str = ""
+    # Flight recorder + latency attribution (ring sizes, histogram
+    # bucket bounds, enable flag) — see ObservabilityConfig.
+    observability: "ObservabilityConfig" = field(
+        default_factory=lambda: ObservabilityConfig()
+    )
 
 
 @dataclass
@@ -633,6 +672,26 @@ class Config:
                 # A chaos config with a typo must fail at parse time,
                 # not silently inject nothing.
                 raise ValueError(f"serving.failpoints: {exc}")
+        obs = self.serving.observability
+        if obs.tick_ring < 1 or obs.request_ring < 1:
+            raise ValueError(
+                "observability.tick_ring/request_ring must be >= 1"
+            )
+        try:
+            bounds = [float(b) for b in obs.bucket_bounds_ms]
+        except (TypeError, ValueError):
+            raise ValueError(
+                "observability.bucket_bounds_ms must be numbers"
+            )
+        if not bounds or any(b <= 0 for b in bounds) or bounds != sorted(
+            set(bounds)
+        ):
+            # Strictly ascending positive bounds: Prometheus le labels
+            # must be unique and ordered or the exposition is invalid.
+            raise ValueError(
+                "observability.bucket_bounds_ms must be strictly "
+                "ascending positive values"
+            )
         if self.serving.speculative_gamma < 1:
             raise ValueError("speculative_gamma must be >= 1")
         if self.training.steps < 1 or self.training.batch_size < 1:

@@ -69,6 +69,10 @@ class Gateway:
         app.router.add_get("/metrics", self.handler.handle_metrics)
         app.router.add_get("/stats", self.handler.handle_stats)
         app.router.add_get("/debug/traces", self.handler.handle_traces)
+        app.router.add_get("/debug/ticks", self.handler.handle_debug_ticks)
+        app.router.add_get(
+            "/debug/requests", self.handler.handle_debug_requests
+        )
         return app
 
     async def start(

@@ -13,8 +13,9 @@ single protocol class that parses HTTP/1.1 with byte ops, runs the SAME
 precomputed-header `bytes` + body per call.
 
 Served surface is identical to the aiohttp app (gateway/app.py routes):
-GET/POST/OPTIONS /, /health, /metrics, /stats, /debug/traces, SSE
-streaming on tools/call. `server.http_impl` selects the implementation;
+GET/POST/OPTIONS /, /health, /metrics, /stats, /debug/traces,
+/debug/ticks, /debug/requests, SSE streaming on tools/call.
+`server.http_impl` selects the implementation;
 both are driven by the same test suite (tests/test_fastlane.py runs the
 gateway protocol tests against this server).
 
@@ -602,6 +603,15 @@ class FastLaneServer:
             query = parse_qs(urlsplit(target).query)
             n = query.get("n", ["100"])[0]
             self._write_json(conn, headers, 200, h.traces_body(n))
+            return 200
+        if path in ("/debug/ticks", "/debug/requests"):
+            query = parse_qs(urlsplit(target).query)
+            body = await h.debug_flight_body(
+                path.rsplit("/", 1)[1],
+                query.get("trace_id", [""])[0],
+                query.get("n", ["128"])[0],
+            )
+            self._write_json(conn, headers, 200, body)
             return 200
         self._write_response(conn, headers, 404, None, b"")
         return 404

@@ -581,6 +581,59 @@ class MCPHandler:
             self.traces_body(request.query.get("n", "100"))
         )
 
+    async def debug_flight_body(
+        self, kind: str, trace_id: str, n_raw: str
+    ) -> dict[str, Any]:
+        """GET /debug/ticks | /debug/requests core: the backends'
+        flight-recorder rings (DebugService.GetFlightRecord fan-out),
+        filterable by the trace id a tool call echoed in X-Trace-Id —
+        the span → request record → tick records walk. `kind` is
+        "ticks" or "requests"; framework-free, shared by the aiohttp
+        handler and the fast lane."""
+        try:
+            n = int(n_raw)
+        except ValueError:
+            n = 128
+        n = max(1, min(n, 2048))
+        entries = await self.discoverer.get_backend_flight_records(
+            trace_id=trace_id,
+            max_ticks=n if kind == "ticks" else 1,
+            max_requests=n if kind == "requests" else 1,
+        )
+        backends = []
+        for entry in entries:
+            if "error" in entry:
+                backends.append(
+                    {"target": entry["target"], "error": entry["error"]}
+                )
+            else:
+                backends.append({
+                    "target": entry["target"],
+                    "enabled": entry.get("enabled", False),
+                    # protojson omits empty repeated fields.
+                    kind: entry.get(kind, []),
+                })
+        body: dict[str, Any] = {"backends": backends}
+        if trace_id:
+            body["traceId"] = trace_id
+        return body
+
+    async def handle_debug_ticks(self, request: web.Request) -> web.Response:
+        return web.json_response(await self.debug_flight_body(
+            "ticks",
+            request.query.get("trace_id", ""),
+            request.query.get("n", "128"),
+        ))
+
+    async def handle_debug_requests(
+        self, request: web.Request
+    ) -> web.Response:
+        return web.json_response(await self.debug_flight_body(
+            "requests",
+            request.query.get("trace_id", ""),
+            request.query.get("n", "128"),
+        ))
+
     # ------------------------------------------------------------------
     # helpers
     # ------------------------------------------------------------------

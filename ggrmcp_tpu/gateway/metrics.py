@@ -6,12 +6,18 @@ its /metrics endpoint returned an ad-hoc JSON dump
 stubs). Here metrics are first-class: prometheus_client counters,
 histograms and gauges, exposed in text format at /metrics, with the
 JSON stats dump preserved at /stats for reference parity.
+
+Backend (ServingStats) export is DESCRIPTOR-DRIVEN: every scalar field
+of ServingStatsResponse becomes a `gateway_backend_<field>` gauge, and
+every `<name>_bucket`/`_sum`/`_count` field triplet becomes a genuine
+`gateway_backend_<name>` Prometheus histogram with per-target buckets
+(rendered by a custom collector from the latest snapshot, cumulative
+`le` semantics). Fields 24-32 used to be hand-synced to a literal gauge
+list; generating from the proto makes "added a field, forgot the gauge"
+impossible, and tests/test_observability.py asserts the invariant.
 """
 
 from __future__ import annotations
-
-import time
-from typing import Optional
 
 try:
     from prometheus_client import (
@@ -22,10 +28,13 @@ try:
         Histogram,
         generate_latest,
     )
+    from prometheus_client.core import HistogramMetricFamily
 
     HAVE_PROMETHEUS = True
 except Exception:  # pragma: no cover - baked into the image, but be safe
     HAVE_PROMETHEUS = False
+
+from ggrmcp_tpu.rpc.pb import serving_pb2
 
 
 _LATENCY_BUCKETS = (
@@ -33,10 +42,156 @@ _LATENCY_BUCKETS = (
     1.0, 2.5, 5.0, 10.0, 30.0,
 )
 
+# Help strings for the descriptor-driven backend gauges; fields without
+# an entry fall back to a generic line (the proto comment remains the
+# authoritative doc). Keep entries for the fields operators dashboard.
+_SERVING_HELP = {
+    "active_slots": "decode slots generating",
+    "total_slots": "decode slot pool size",
+    "queued_requests": "requests waiting for a slot",
+    "kv_cache_bytes": "KV-cache HBM bytes",
+    "prefix_cache_hits": "prefix cache hits",
+    "prefix_cache_misses": "prefix cache misses",
+    "decode_steps": "fused decode steps issued",
+    "speculative_calls": "speculative device calls",
+    "speculative_requests": "requests served speculatively",
+    "interleaved_chunks": "prefill chunks fused into decode ticks",
+    "interleaved_admissions":
+        "requests admitted via tick-interleaved prefill",
+    "decode_stall_ms_p50":
+        "median gap between a live slot's token emissions",
+    "decode_stall_ms_p99":
+        "p99 gap between a live slot's token emissions",
+    "queued_tokens": "prompt tokens held by queued requests",
+    "timed_out": "requests expired in queue past queue_deadline_ms",
+    "shed_requests":
+        "submits refused by bounded admission (OverloadedError)",
+    "replayed_requests":
+        "requests requeued with a replay prefix after a failed tick",
+    "replay_exhausted":
+        "requests that exhausted tick_retry_limit and errored",
+}
+
+_SERVING_HIST_HELP = {
+    "ttft_ms": "backend time-to-first-token (ms), true histogram",
+    "e2e_ms": "backend submit-to-terminal-chunk latency (ms)",
+    "queue_ms": "backend admission-queue wait (ms)",
+    "tick_duration_ms": "decode tick dispatch-to-collect latency (ms)",
+}
+
 
 def _snake_to_camel(name: str) -> str:
     head, *rest = name.split("_")
     return head + "".join(part.title() for part in rest)
+
+
+def _is_repeated(field) -> bool:
+    # protobuf >= 5 deprecates FieldDescriptor.label in favor of the
+    # is_repeated property; support both without tripping the warning.
+    rep = getattr(field, "is_repeated", None)
+    if rep is not None:
+        return bool(rep)
+    return field.label == field.LABEL_REPEATED
+
+
+def serving_histogram_names() -> list[str]:
+    """Histogram base names derived from the ServingStatsResponse
+    descriptor: every repeated `<base>_bucket` field declares one (its
+    `_sum`/`_count` scalars and the shared bounds field belong to it,
+    not to the gauge set)."""
+    desc = serving_pb2.ServingStatsResponse.DESCRIPTOR
+    return [
+        f.name[: -len("_bucket")]
+        for f in desc.fields
+        if _is_repeated(f) and f.name.endswith("_bucket")
+    ]
+
+
+def serving_gauge_names() -> list[str]:
+    """Gauge names derived from the descriptor: every scalar
+    (non-repeated) field that is not part of a histogram triplet."""
+    desc = serving_pb2.ServingStatsResponse.DESCRIPTOR
+    hist_members = set()
+    for base in serving_histogram_names():
+        hist_members.update((f"{base}_sum", f"{base}_count"))
+    return [
+        f.name
+        for f in desc.fields
+        if not _is_repeated(f) and f.name not in hist_members
+    ]
+
+
+class _ServingHistogramCollector:
+    """Renders the backends' latest ServingStats histogram snapshot as
+    real Prometheus histogram families (`gateway_backend_<name>` with
+    `_bucket{le=...}`/`_sum`/`_count` series per target). A custom
+    collector because prometheus_client's Histogram cannot be set from
+    pre-aggregated bucket counts — and the counts here are authoritative
+    on the backend, the gateway only re-exposes them."""
+
+    def __init__(self) -> None:
+        # target -> base name -> (bounds tuple, counts list, sum)
+        self.snap: dict[str, dict[str, tuple]] = {}
+
+    def collect(self):
+        for name in serving_histogram_names():
+            family = HistogramMetricFamily(
+                f"gateway_backend_{name}",
+                f"Backend ServingStats: "
+                f"{_SERVING_HIST_HELP.get(name, name)}",
+                labels=["target"],
+            )
+            for target in sorted(self.snap):
+                data = self.snap[target].get(name)
+                if data is None:
+                    continue
+                bounds, counts, total_sum = data
+                buckets = []
+                cum = 0
+                for bound, count in zip(bounds, counts):
+                    cum += count
+                    buckets.append((str(float(bound)), cum))
+                # counts carries one overflow slot past the bounds.
+                cum += sum(counts[len(bounds):])
+                buckets.append(("+Inf", cum))
+                family.add_metric([target], buckets, total_sum)
+            yield family
+
+    def update(self, target: str, per_backend_entry: dict) -> bool:
+        """Parse one protojson ServingStats entry into the snapshot;
+        returns False when the entry carries no histogram data (an old
+        backend or histograms disabled) so the caller can drop the
+        target instead of exporting empty families."""
+        bounds = per_backend_entry.get("latencyBucketBoundsMs")
+        if not bounds:
+            self.snap.pop(target, None)
+            return False
+        bounds = tuple(float(b) for b in bounds)
+        per: dict[str, tuple] = {}
+        for name in serving_histogram_names():
+            counts = [
+                int(float(c))
+                for c in per_backend_entry.get(
+                    _snake_to_camel(f"{name}_bucket"), []
+                )
+            ]
+            if len(counts) != len(bounds) + 1:
+                # Zero observations (protojson omits empty repeated
+                # fields) or a bounds/counts length mismatch: render a
+                # well-formed all-zero histogram rather than a torn one.
+                counts = [0] * (len(bounds) + 1)
+            per[name] = (
+                bounds,
+                counts,
+                float(per_backend_entry.get(
+                    _snake_to_camel(f"{name}_sum"), 0.0
+                )),
+            )
+        self.snap[target] = per
+        return True
+
+    def remove(self, target: str) -> None:
+        self.snap.pop(target, None)
 
 
 class GatewayMetrics:
@@ -97,45 +252,25 @@ class GatewayMetrics:
         )
         # Model-plane gauges, scraped from each TPU sidecar backend's
         # ServingStats RPC at /metrics time (zeros until first scrape;
-        # absent for backends without the RPC).
+        # absent for backends without the RPC). The set is generated
+        # from the proto descriptor — EVERY scalar ServingStats field
+        # exports, by construction.
         self.serving_gauges = {
             name: Gauge(
                 f"gateway_backend_{name}",
-                f"Backend ServingStats: {help_}",
+                f"Backend ServingStats: "
+                f"{_SERVING_HELP.get(name, f'{name} (see protos/serving.proto)')}",
                 ["target"],
                 registry=self.registry,
             )
-            for name, help_ in [
-                ("active_slots", "decode slots generating"),
-                ("total_slots", "decode slot pool size"),
-                ("queued_requests", "requests waiting for a slot"),
-                ("kv_cache_bytes", "KV-cache HBM bytes"),
-                ("prefix_cache_hits", "prefix cache hits"),
-                ("prefix_cache_misses", "prefix cache misses"),
-                ("decode_steps", "fused decode steps issued"),
-                ("speculative_calls", "speculative device calls"),
-                ("speculative_requests", "requests served speculatively"),
-                ("interleaved_chunks",
-                 "prefill chunks fused into decode ticks"),
-                ("interleaved_admissions",
-                 "requests admitted via tick-interleaved prefill"),
-                ("decode_stall_ms_p50",
-                 "median gap between a live slot's token emissions"),
-                ("decode_stall_ms_p99",
-                 "p99 gap between a live slot's token emissions"),
-                ("queued_tokens",
-                 "prompt tokens held by queued requests"),
-                ("timed_out",
-                 "requests expired in queue past queue_deadline_ms"),
-                ("shed_requests",
-                 "submits refused by bounded admission (OverloadedError)"),
-                ("replayed_requests",
-                 "requests requeued with a replay prefix after a "
-                 "failed tick"),
-                ("replay_exhausted",
-                 "requests that exhausted tick_retry_limit and errored"),
-            ]
+            for name in serving_gauge_names()
         }
+        # True backend latency histograms (ttft/e2e/queue/tick
+        # duration): pre-bucketed on the backend by the flight
+        # recorder, re-exposed here with real `le` series so PromQL
+        # can aggregate across backends and compute window quantiles.
+        self.serving_histograms = _ServingHistogramCollector()
+        self.registry.register(self.serving_histograms)
         # The overload early-warning gauge: admission-queue depth per
         # backend in both units (unit="requests" | "tokens") — watch
         # this against batching.max_pending / max_queue_tokens to see
@@ -213,6 +348,7 @@ class GatewayMetrics:
                 # strings and doubles as numbers — float() takes both,
                 # and the millisecond stall gauges carry fractions.
                 self._child(gauge, target).set(float(value))
+            self.serving_histograms.update(target, entry)
             for unit, key in (("requests", "queuedRequests"),
                               ("tokens", "queuedTokens")):
                 self._child(
@@ -225,6 +361,7 @@ class GatewayMetrics:
                 except KeyError:
                     pass
                 self._children.pop((id(gauge), target), None)
+            self.serving_histograms.remove(target)
             for unit in ("requests", "tokens"):
                 try:
                     self.batcher_pending_depth.remove(target, unit)
@@ -240,14 +377,3 @@ class GatewayMetrics:
         if self.registry is None:
             return b"# prometheus_client unavailable\n", "text/plain"
         return generate_latest(self.registry), CONTENT_TYPE_LATEST
-
-
-class Timer:
-    __slots__ = ("start", "elapsed")
-
-    def __enter__(self) -> "Timer":
-        self.start = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc) -> None:
-        self.elapsed = time.perf_counter() - self.start

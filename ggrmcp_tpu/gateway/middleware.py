@@ -29,7 +29,8 @@ Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
 # The gateway's route table (gateway/app.py); used to bound the
 # cardinality of the HTTP metrics path label.
 _KNOWN_PATHS = frozenset(
-    {"/", "/health", "/metrics", "/stats", "/debug/traces"}
+    {"/", "/health", "/metrics", "/stats", "/debug/traces",
+     "/debug/ticks", "/debug/requests"}
 )
 
 

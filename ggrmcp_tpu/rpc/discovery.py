@@ -394,6 +394,51 @@ class ServiceDiscoverer:
     # -- health / stats -----------------------------------------------------
 
     SERVING_STATS_METHOD = "ggrmcp.tpu.ModelInfoService.GetServingStats"
+    FLIGHT_RECORD_METHOD = "ggrmcp.tpu.DebugService.GetFlightRecord"
+
+    async def get_backend_flight_records(
+        self,
+        trace_id: str = "",
+        max_ticks: int = 0,
+        max_requests: int = 0,
+        timeout_s: float = 2.0,
+    ) -> list[dict[str, Any]]:
+        """Flight-recorder rings from every healthy backend exposing
+        DebugService.GetFlightRecord (TPU sidecars), one protojson
+        entry per backend — the /debug/ticks and /debug/requests body.
+        Same failure contract as get_backend_serving_stats: a slow or
+        failed backend contributes an error entry, never an exception."""
+        arguments: dict[str, Any] = {}
+        if trace_id:
+            arguments["traceId"] = trace_id
+        if max_ticks:
+            arguments["maxTicks"] = int(max_ticks)
+        if max_requests:
+            arguments["maxRequests"] = int(max_requests)
+
+        async def call(backend: Backend, mi) -> dict[str, Any]:
+            try:
+                out = await backend.invoker.invoke(
+                    mi, arguments, None, timeout_s
+                )
+                return {"target": backend.target, **out}
+            except Exception as exc:  # noqa: BLE001 — diagnostics only
+                return {"target": backend.target, "error": str(exc)}
+
+        jobs = []
+        for backend in self.backends:
+            if not backend.healthy or backend.invoker is None:
+                continue
+            mi = next(
+                (
+                    m for m in backend.methods
+                    if m.full_name == self.FLIGHT_RECORD_METHOD
+                ),
+                None,
+            )
+            if mi is not None:
+                jobs.append(call(backend, mi))
+        return list(await asyncio.gather(*jobs)) if jobs else []
 
     async def get_backend_serving_stats(
         self, timeout_s: float = 2.0

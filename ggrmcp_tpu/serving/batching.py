@@ -31,8 +31,9 @@ from ggrmcp_tpu.models import llama as llama_mod
 from ggrmcp_tpu.ops import quant
 from ggrmcp_tpu.ops.sampling import SamplingConfig, sample_dynamic
 from ggrmcp_tpu.serving.engine import bucket_len, fit_request
+from ggrmcp_tpu.serving.flight_recorder import FlightRecorder
 from ggrmcp_tpu.utils import failpoints
-from ggrmcp_tpu.utils.stats import nearest_rank
+from ggrmcp_tpu.utils.stats import pct
 
 logger = logging.getLogger("ggrmcp.serving.batching")
 
@@ -182,6 +183,15 @@ class _Request:
     t_submit: float = 0.0
     t_admit: float = 0.0
     queue_ms: float = 0.0
+    # Flight-recorder lifecycle (serving/flight_recorder.py): the
+    # gateway trace id this request decodes under (join key into the
+    # span and tick rings), the first-token stamp TTFT derives from,
+    # the original (pre-replay-fold) prompt length, and the first tick
+    # seq this request decoded in (-1 = never admitted).
+    trace_id: str = ""
+    t_first: float = 0.0
+    n_prompt: int = 0
+    first_tick: int = -1
 
 
 class ContinuousBatcher:
@@ -344,6 +354,13 @@ class ContinuousBatcher:
         self.shed = 0
         self.replayed = 0
         self.replay_exhausted = 0
+        # Flight recorder: per-tick + per-request rings and the
+        # ttft/e2e/queue/tick-duration histograms
+        # (serving.observability; the tiered facade stamps each tier's
+        # recorder with a source label after construction).
+        self.recorder = FlightRecorder(
+            getattr(getattr(engine, "serving", None), "observability", None)
+        )
 
         # jitted: one decode tick for the whole slot pool (params ride
         # as an argument — a closed-over weight tree would be lowered
@@ -1020,6 +1037,11 @@ class ContinuousBatcher:
         slot.reserved = False
         request.t_admit = time.perf_counter()
         request.queue_ms = (request.t_admit - request.t_submit) * 1000.0
+        # First decode tick this request can participate in is the NEXT
+        # dispatch (ticks is the count of dispatched ticks; records are
+        # 1-based on the same counter).
+        request.first_tick = self.timing["ticks"] + 1
+        self.recorder.note_admit()
         self.cur_tokens[slot_idx] = first_tok
         if self._cur_dev is not None:
             self._cur_dev = self._cur_dev.at[slot_idx].set(first_tok)
@@ -1249,13 +1271,17 @@ class ContinuousBatcher:
         seed: int = 0,
         unary: bool = False,
         adapter: int = 0,
+        trace_id: str = "",
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         """Enqueue a request; yields (token_ids_chunk, finish_reason)
         pairs; finish_reason is set on the final chunk. `unary=True`
         (non-streaming consumers): one terminal chunk with all tokens —
         same iterator contract, a fraction of the cross-thread events
         (see _Request.unary). `adapter`: LoRA adapter row id (0 = base;
-        resolve names via engine.resolve_adapter).
+        resolve names via engine.resolve_adapter). `trace_id`: the
+        gateway trace this request serves — stamped into the flight
+        recorder's request/tick records so one id walks span → request
+        record → tick records.
 
         Validation, the admission-cap check, and the enqueue all run
         HERE, eagerly, not at first iteration of the returned
@@ -1305,7 +1331,8 @@ class ContinuousBatcher:
             )
         request = _Request(
             prompt=prompt, max_new=max_new, sampling=sampling, seed=seed,
-            unary=unary, adapter=adapter,
+            unary=unary, adapter=adapter, trace_id=trace_id,
+            n_prompt=len(prompt),
         )
         request.t_submit = time.perf_counter()
         self.pending.put_nowait(request)
@@ -1348,10 +1375,12 @@ class ContinuousBatcher:
     @staticmethod
     def stall_percentiles(records: list[float]) -> dict:
         """Decode-stall histogram summary — the admission-induced gap
-        distribution prefill_interleave bounds to ~one chunk."""
+        distribution prefill_interleave bounds to ~one chunk. pct is
+        the shared ceil-based nearest-rank reporter (utils/stats.py),
+        one formula for batcher, bench, and flight-recorder output."""
         return {
-            "decode_stall_ms_p50": round(nearest_rank(records, 0.5), 2),
-            "decode_stall_ms_p99": round(nearest_rank(records, 0.99), 2),
+            "decode_stall_ms_p50": pct(records, 0.5),
+            "decode_stall_ms_p99": pct(records, 0.99),
             "decode_stall_ms_max": (
                 round(max(records), 2) if records else 0.0
             ),
@@ -1361,19 +1390,7 @@ class ContinuousBatcher:
     def lat_percentiles(records: list[tuple[float, float]]) -> dict:
         """Queue/service percentiles from (queue_ms, service_ms)
         records — the queue-time vs device-time split the SLO policy
-        is judged on."""
-        if not records:
-            return {
-                "queue_ms_p50": 0.0, "queue_ms_p99": 0.0,
-                "service_ms_p50": 0.0, "service_ms_p99": 0.0,
-            }
-
-        def pct(vals: list[float], p: float) -> float:
-            # Nearest-rank: ceil(n*p)-th smallest — at n=100, p99 is
-            # vals[98], not the window max (utils/stats.py, shared
-            # with the bench's reported percentiles).
-            return round(nearest_rank(vals, p), 2)
-
+        is judged on (pct: shared nearest-rank, utils/stats.py)."""
         qs = [r[0] for r in records]
         ss = [r[1] for r in records]
         return {
@@ -1382,13 +1399,36 @@ class ContinuousBatcher:
         }
 
     def stats(self) -> dict:
-        """Live counters + latency percentiles for the ServingStats
-        RPC / diagnostics."""
+        """Live counters + latency percentiles + flight-recorder
+        histograms for the ServingStats RPC / diagnostics."""
         return {
             **self.counter_stats(),
             **self.lat_percentiles(self.lat_snapshot()),
             **self.stall_percentiles(self.stall_snapshot()),
+            **self.recorder.histogram_stats(),
         }
+
+    def flight_snapshot(
+        self,
+        max_ticks: int = 128,
+        max_requests: int = 128,
+        trace_id: str = "",
+    ) -> tuple[list, list]:
+        """(tick records, request records), oldest first, optionally
+        filtered to the records a trace id participated in — the
+        DebugService.GetFlightRecord body (sidecar) and the bench's
+        TTFT source."""
+        ticks = self.recorder.tick_snapshot()
+        requests = self.recorder.request_snapshot()
+        if trace_id:
+            ticks = [t for t in ticks if trace_id in t.trace_ids]
+            requests = [r for r in requests if r.trace_id == trace_id]
+        return ticks[-max(1, max_ticks):], requests[-max(1, max_requests):]
+
+    def request_record(self, trace_id: str):
+        """Latest flight-recorder request record for a trace id (the
+        sidecar's span-attribution lookup)."""
+        return self.recorder.request_record(trace_id)
 
     def counter_stats(self) -> dict:
         """Summable counters only (no percentiles) — what the tiered
@@ -1496,6 +1536,23 @@ class ContinuousBatcher:
         while self._inflight:
             self._tick_collect_one()
 
+    def _record_terminal(self, request: _Request, reason: str) -> None:
+        """Flight-record a request's terminal outcome — called on EVERY
+        path that queues a terminal chunk (emission finish, queue
+        timeout, replay exhaustion, cancellation, admission failure),
+        so the request ring accounts for failures, not only successes."""
+        if not self.recorder.enabled:
+            return
+        if request.first_tick >= 0:
+            last_tick = max(request.first_tick, self.timing["ticks"])
+        else:
+            last_tick = -1
+        self.recorder.record_request(
+            request.trace_id, request.t_submit, request.t_admit,
+            request.t_first, request.n_prompt, len(request.acc),
+            reason, request.first_tick, last_tick,
+        )
+
     def _replay_or_fail(self, request: _Request) -> None:
         """One victim of a failed device call. With retry budget left,
         requeue it at the head of the admission queue with its emitted
@@ -1508,12 +1565,14 @@ class ContinuousBatcher:
         surfaces finish_reason "error"."""
         if request.cancelled:
             # The consumer is gone; freeing the slot is the recovery.
+            self._record_terminal(request, "cancelled")
             self._loop_ref.call_soon_threadsafe(
                 request.out.put_nowait, ([], "cancelled")
             )
             return
         if request.retries >= self.cfg.tick_retry_limit:
             self.replay_exhausted += 1
+            self._record_terminal(request, "error")
             self._loop_ref.call_soon_threadsafe(
                 request.out.put_nowait, ([], "error")
             )
@@ -1594,6 +1653,7 @@ class ContinuousBatcher:
                 continue  # consumer gone; just release the queue slot
             if (now - request.t_submit) * 1000.0 > ddl:
                 self.timed_out += 1
+                self._record_terminal(request, "timeout")
                 request.out.put_nowait(([], "timeout"))
             else:
                 keep.append(request)
@@ -1655,6 +1715,7 @@ class ContinuousBatcher:
                     # Expired in queue: fail fast instead of spending
                     # prefill on a call the client has abandoned.
                     self.timed_out += 1
+                    self._record_terminal(request, "timeout")
                     request.out.put_nowait(([], "timeout"))
                     continue
                 batch.append(request)
@@ -1686,6 +1747,7 @@ class ContinuousBatcher:
                 }
                 for request in batch:
                     if id(request) not in activated:
+                        self._record_terminal(request, "error")
                         self._loop_ref.call_soon_threadsafe(
                             request.out.put_nowait, ([], "error")
                         )
@@ -1973,11 +2035,32 @@ class ContinuousBatcher:
         while len(self._inflight) > depth:
             self._tick_collect_one()
 
+    def _tick_record(self, active, ilv_rows: int = 0):
+        """Open this tick's flight record at dispatch (None when the
+        recorder is disabled). seq is 1-based on timing["ticks"], the
+        same counter _activate_slot stamps first_tick from."""
+        if not self.recorder.enabled:
+            return None
+        trace_ids = list(dict.fromkeys(
+            s.request.trace_id for s in self.slots
+            if s.active and s.request is not None and s.request.trace_id
+        ))
+        return self.recorder.tick_start(
+            seq=self.timing["ticks"] + 1,
+            active=int(active.sum()),
+            interleaved_rows=ilv_rows,
+            trace_ids=trace_ids,
+            shed=self.shed,
+            replayed=self.replayed,
+            timed_out=self.timed_out,
+        )
+
     def _tick_dispatch(self) -> None:
         t0 = time.perf_counter()
         step0 = self.step_counter
         self.step_counter += self._steps_per_tick
         active = np.array([s.active for s in self.slots], bool)
+        rec = self._tick_record(active)
         if self._cur_dev is None:
             self._cur_dev = jnp.asarray(self.cur_tokens)
         toks, self.cache = self._tick(
@@ -1998,7 +2081,7 @@ class ContinuousBatcher:
         # finish (tick N's emission) and be re-admitted before tick
         # N+1's junk row for the old request is collected.
         owners = [s.request if s.active else None for s in self.slots]
-        self._inflight.append((toks, owners))
+        self._inflight.append((toks, owners, rec))
         self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
         self.timing["ticks"] += 1
 
@@ -2040,6 +2123,7 @@ class ContinuousBatcher:
             c_tl[r] = st.n
             c_valid[r] = True
             c_adapt[r] = st.request.adapter
+        rec = self._tick_record(active, ilv_rows=int(c_valid.sum()))
         toks, self.cache, self._ilv_mini, sel = self._tick_chunk(
             self.engine.params, self._cur_dev, self.cache,
             jnp.asarray(self.seeds), jnp.int32(step0 + 1),
@@ -2055,7 +2139,7 @@ class ContinuousBatcher:
         except (AttributeError, RuntimeError):
             pass
         owners = [s.request if s.active else None for s in self.slots]
-        self._inflight.append((toks, owners))
+        self._inflight.append((toks, owners, rec))
         self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
         self.timing["ticks"] += 1
         done: list[int] = []
@@ -2095,10 +2179,11 @@ class ContinuousBatcher:
         possibly re-admitted — since dispatch) are dropped: their
         tokens are the junk a parked slot keeps sampling."""
         t0 = time.perf_counter()
-        toks_dev, owners = self._inflight.popleft()
+        toks_dev, owners, rec = self._inflight.popleft()
         toks = np.asarray(toks_dev)  # [B, steps_per_tick]
         self.timing["tick_collect_ms"] += (time.perf_counter() - t0) * 1000.0
         self.timing["collects"] += 1
+        finished = 0
         for i, request in enumerate(owners):
             if request is None:
                 continue
@@ -2107,6 +2192,9 @@ class ContinuousBatcher:
                 continue
             self.cur_tokens[i] = toks[i, -1]
             self._emit_chunk(i, toks[i])
+            if self.slots[i].request is not request:
+                finished += 1
+        self.recorder.tick_done(rec, finished)
 
     def _emit_chunk(self, slot_idx: int, tokens) -> None:
         """Deliver a tick's tokens for one slot: truncate at EOS or the
@@ -2134,6 +2222,11 @@ class ContinuousBatcher:
         # emission (admission-induced stalls land here — the histogram
         # prefill_interleave exists to flatten).
         now = time.perf_counter()
+        if request.t_first == 0.0:
+            # First token produced (the activation emit): the TTFT
+            # stamp — generation time, not consumer-delivery time, so
+            # unary and streaming consumers measure identically.
+            request.t_first = now
         last = self._slot_last_emit[slot_idx]
         if last is not None:
             self._stall_records.append((now - last) * 1000.0)
@@ -2159,6 +2252,8 @@ class ContinuousBatcher:
         # consumers it is the terminal payload; for ALL consumers it
         # is the replay prefix a tick failure resumes from.
         request.acc.extend(ids)
+        if finished_reason is not None:
+            self._record_terminal(request, finished_reason)
         if request.unary:
             if finished_reason is not None:
                 self._loop_ref.call_soon_threadsafe(

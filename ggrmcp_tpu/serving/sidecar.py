@@ -187,6 +187,9 @@ class Sidecar:
     async def generate(self, request: serving_pb2.GenerateRequest, context):
         assert self.generation is not None and self.batcher is not None
         t0 = time.perf_counter()
+        trace_id = tracing.trace_id_from_metadata(
+            context.invocation_metadata()
+        )
         prompt = self._prompt_ids(request)
         max_new = request.max_new_tokens or 64
         max_new = min(max_new, self.serving.batching.max_decode_steps)
@@ -215,9 +218,7 @@ class Sidecar:
         )
         with tracing.tracer.span(
             "sidecar.generate",
-            trace_id=tracing.trace_id_from_metadata(
-                context.invocation_metadata()
-            ) or None,
+            trace_id=trace_id or None,
             model=self.generation.cfg.name, prompt_tokens=len(prompt),
         ) as span:
             if speculative:
@@ -230,7 +231,7 @@ class Sidecar:
                     token_ids, finish, stats = await self.spec_batcher.submit(
                         prompt, max_new,
                         temperature=max(0.0, sampling.temperature),
-                        seed=seed,
+                        seed=seed, trace_id=trace_id,
                     )
                     span.set(**stats)
                 except Exception:
@@ -242,7 +243,7 @@ class Sidecar:
                 try:
                     it = self.batcher.submit(
                         prompt, max_new, sampling, seed, unary=True,
-                        adapter=adapter,
+                        adapter=adapter, trace_id=trace_id,
                     )
                 except OverloadedError as exc:
                     # Load shedding, not failure: RESOURCE_EXHAUSTED is
@@ -257,6 +258,7 @@ class Sidecar:
                     if reason:
                         finish = reason
             span.set(completion_tokens=len(token_ids), finish=finish)
+            self._attribute_span(span, trace_id, speculative)
         if finish == "error":
             await context.abort(
                 grpc.StatusCode.INTERNAL, "generation failed on the backend"
@@ -275,6 +277,9 @@ class Sidecar:
 
     async def generate_stream(self, request: serving_pb2.GenerateRequest, context):
         assert self.generation is not None and self.batcher is not None
+        trace_id = tracing.trace_id_from_metadata(
+            context.invocation_metadata()
+        )
         prompt = self._prompt_ids(request)
         max_new = min(
             request.max_new_tokens or 64, self.serving.batching.max_decode_steps
@@ -300,7 +305,7 @@ class Sidecar:
         try:
             it = self.batcher.submit(
                 prompt, max_new, self._sampling(request), seed,
-                adapter=adapter,
+                adapter=adapter, trace_id=trace_id,
             )
         except OverloadedError as exc:
             # Shed before any chunk is written — same overload contract
@@ -336,6 +341,24 @@ class Sidecar:
                 return
         yield serving_pb2.GenerateChunk(finish_reason="length", done=True)
 
+    def _attribute_span(self, span, trace_id: str, speculative: bool) -> None:
+        """Stamp the flight-recorder lifecycle onto this call's span —
+        ttft_ms plus the tick-seq range — so one trace id walks span →
+        request record → tick records (/debug/traces → /debug/requests
+        → /debug/ticks)."""
+        if not trace_id:
+            return
+        source = self.spec_batcher if speculative else self.batcher
+        rec = source.request_record(trace_id) if source is not None else None
+        if rec is None:
+            return
+        span.set(
+            ttft_ms=round(rec.ttft_ms, 3),
+            queue_ms=round(rec.queue_ms, 3),
+            first_tick=rec.first_tick,
+            last_tick=rec.last_tick,
+        )
+
     # ------------------------------------------------------------------
     # ModelInfoService
     # ------------------------------------------------------------------
@@ -354,6 +377,19 @@ class Sidecar:
                 stats.get("queued_requests", 0)
                 + self.spec_batcher.queue.qsize()
             )
+            # Latency histograms are summable by construction: merge
+            # the speculative recorder's buckets into the batcher's so
+            # the exported ttft/e2e distributions cover BOTH serving
+            # paths.
+            from ggrmcp_tpu.serving.flight_recorder import FlightRecorder
+
+            spec_hist = self.spec_batcher.recorder.histogram_stats()
+            batch_hist = {
+                k: stats.pop(k) for k in list(spec_hist) if k in stats
+            }
+            stats.update(FlightRecorder.merge_histogram_stats(
+                [batch_hist, spec_hist]
+            ))
         return serving_pb2.ServingStatsResponse(**stats)
 
     async def get_model_info(self, request, context):
@@ -412,6 +448,65 @@ class Sidecar:
             output_path=path, duration_ms=duration_ms
         )
 
+    async def get_flight_record(
+        self, request: serving_pb2.FlightRecordRequest, context
+    ):
+        """Flight-recorder rings: per-tick and per-request lifecycle
+        records, optionally filtered to one trace id — the postmortem
+        RPC behind the gateway's /debug/ticks and /debug/requests.
+        Snapshot reads of host state; no device work, no locks held
+        across the engine."""
+        max_ticks = request.max_ticks or 128
+        max_requests = request.max_requests or 128
+        ticks: list = []
+        requests: list = []
+        enabled = False
+        if self.batcher is not None:
+            enabled = any(
+                t.recorder.enabled
+                for t in getattr(self.batcher, "tiers", [self.batcher])
+            )
+            ticks, requests = self.batcher.flight_snapshot(
+                max_ticks, max_requests, request.trace_id
+            )
+        if self.spec_batcher is not None:
+            enabled = enabled or self.spec_batcher.recorder.enabled
+            spec_requests = self.spec_batcher.recorder.request_snapshot()
+            if request.trace_id:
+                spec_requests = [
+                    r for r in spec_requests
+                    if r.trace_id == request.trace_id
+                ]
+            requests = sorted(
+                requests + spec_requests, key=lambda r: r.t_submit
+            )[-max_requests:]
+        return serving_pb2.FlightRecordResponse(
+            ticks=[
+                serving_pb2.TickRecord(
+                    seq=t.seq, t_wall=t.t_wall, t_mono=t.t_mono,
+                    duration_ms=t.duration_ms, active_slots=t.active_slots,
+                    admitted=t.admitted, finished=t.finished,
+                    interleaved_rows=t.interleaved_rows,
+                    shed_total=t.shed_total, replayed_total=t.replayed_total,
+                    timed_out_total=t.timed_out_total,
+                    trace_ids=t.trace_ids, source=t.source,
+                )
+                for t in ticks
+            ],
+            requests=[
+                serving_pb2.RequestRecord(
+                    trace_id=r.trace_id, t_submit=r.t_submit,
+                    queue_ms=r.queue_ms, ttft_ms=r.ttft_ms, e2e_ms=r.e2e_ms,
+                    prompt_tokens=r.prompt_tokens, tokens=r.tokens,
+                    finish_reason=r.finish_reason, decode_tps=r.decode_tps,
+                    first_tick=r.first_tick, last_tick=r.last_tick,
+                    source=r.source,
+                )
+                for r in requests
+            ],
+            enabled=enabled,
+        )
+
     # ------------------------------------------------------------------
     # Server lifecycle
     # ------------------------------------------------------------------
@@ -466,10 +561,17 @@ class Sidecar:
         services.append("ggrmcp.tpu.DebugService")
         add_service(
             self.server, "ggrmcp.tpu.DebugService",
-            {"Profile": MethodDef(
-                self.profile,
-                serving_pb2.ProfileRequest, serving_pb2.ProfileResponse,
-            )},
+            {
+                "Profile": MethodDef(
+                    self.profile,
+                    serving_pb2.ProfileRequest, serving_pb2.ProfileResponse,
+                ),
+                "GetFlightRecord": MethodDef(
+                    self.get_flight_record,
+                    serving_pb2.FlightRecordRequest,
+                    serving_pb2.FlightRecordResponse,
+                ),
+            },
         )
         ReflectionService(services).attach(self.server)
         self.health.attach(self.server)

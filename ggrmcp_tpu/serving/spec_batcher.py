@@ -27,6 +27,7 @@ import time
 from typing import Optional
 
 from ggrmcp_tpu.core.config import BatchingConfig
+from ggrmcp_tpu.serving.flight_recorder import FlightRecorder
 
 logger = logging.getLogger("ggrmcp.serving.spec_batcher")
 
@@ -50,6 +51,15 @@ class SpeculativeBatcher:
         self.requests = 0
         self.drafted = 0
         self.accepted = 0
+        # Request-lifecycle ring + latency histograms, merged into the
+        # sidecar's ServingStats/flight-record views alongside the
+        # continuous batcher's. Speculative calls are one-shot (the
+        # whole completion lands at once), so ttft == e2e and there is
+        # no queue split or tick linkage.
+        self.recorder = FlightRecorder(
+            getattr(getattr(engine, "serving", None), "observability", None),
+            source="spec",
+        )
 
     def start(self) -> None:
         if self._task is None:
@@ -81,15 +91,29 @@ class SpeculativeBatcher:
     async def submit(
         self, prompt: list[int], max_new: int,
         temperature: float = 0.0, seed: int = 0,
+        trace_id: str = "",
     ) -> tuple[list[int], str, dict]:
         """Returns (token_ids, finish_reason, stats). Greedy rows
         (temperature 0) produce output identical to a solo
         `generate_speculative([prompt], max_new)` call; sampled rows
         are rejection-sampled (distribution-lossless, seeded per
         row)."""
+        t_submit = time.perf_counter()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         await self.queue.put((prompt, max_new, float(temperature), seed, fut))
-        return await fut
+        try:
+            ids, reason, stats = await fut
+        except BaseException:
+            self.recorder.record_request(
+                trace_id, t_submit, 0.0, 0.0, len(prompt), 0, "error",
+                -1, -1,
+            )
+            raise
+        self.recorder.record_request(
+            trace_id, t_submit, 0.0, time.perf_counter(), len(prompt),
+            len(ids), reason, -1, -1,
+        )
+        return ids, reason, stats
 
     async def _loop(self) -> None:
         loop = asyncio.get_running_loop()

@@ -60,9 +60,11 @@ class TieredBatcher:
                     else cfg.prefix_cache_entries
                 ),
             )
-            self.tiers.append(
-                ContinuousBatcher(engine, tier_cfg, eos_id=eos_id)
-            )
+            tier_batcher = ContinuousBatcher(engine, tier_cfg, eos_id=eos_id)
+            # Tick seq counters are per-tier; the source label is what
+            # keeps merged flight records unambiguous downstream.
+            tier_batcher.recorder.source = f"tier-{int(max_seq)}"
+            self.tiers.append(tier_batcher)
         logger.info(
             "tiered KV cache: %s",
             [(t.max_seq, len(t.slots)) for t in self.tiers],
@@ -113,6 +115,7 @@ class TieredBatcher:
         seed: int = 0,
         unary: bool = False,
         adapter: int = 0,
+        trace_id: str = "",
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         last_exc: Optional[OverloadedError] = None
         probed: list[ContinuousBatcher] = []
@@ -120,7 +123,7 @@ class TieredBatcher:
             try:
                 it = tier.submit(
                     prompt, max_new, sampling, seed, unary=unary,
-                    adapter=adapter,
+                    adapter=adapter, trace_id=trace_id,
                 )
             except OverloadedError as exc:
                 last_exc = exc
@@ -156,7 +159,11 @@ class TieredBatcher:
         queue/service (and decode-stall) percentiles are computed ONCE
         over the concatenated per-tier records (summing a p50 is
         meaningless, and per-tier percentile sorts would be wasted
-        work on every scrape)."""
+        work on every scrape); histogram bucket counts merge
+        elementwise (histograms, unlike percentiles, ARE summable —
+        the whole point of exporting them)."""
+        from ggrmcp_tpu.serving.flight_recorder import FlightRecorder
+
         per_tier = [t.counter_stats() for t in self.tiers]
         records: list = []
         for t in self.tiers:
@@ -172,7 +179,37 @@ class TieredBatcher:
             },
             **ContinuousBatcher.lat_percentiles(records),
             **ContinuousBatcher.stall_percentiles(self.stall_snapshot()),
+            **FlightRecorder.merge_histogram_stats(
+                [t.recorder.histogram_stats() for t in self.tiers]
+            ),
         }
+
+    def flight_snapshot(
+        self,
+        max_ticks: int = 128,
+        max_requests: int = 128,
+        trace_id: str = "",
+    ) -> tuple[list, list]:
+        """Merged per-tier flight records, ordered by wall-clock stamp
+        (tick seq counters are per-tier; `source` disambiguates)."""
+        ticks: list = []
+        requests: list = []
+        for tier in self.tiers:
+            t_ticks, t_requests = tier.flight_snapshot(
+                max_ticks, max_requests, trace_id
+            )
+            ticks.extend(t_ticks)
+            requests.extend(t_requests)
+        ticks.sort(key=lambda r: r.t_wall)
+        requests.sort(key=lambda r: r.t_submit)
+        return ticks[-max(1, max_ticks):], requests[-max(1, max_requests):]
+
+    def request_record(self, trace_id: str):
+        for tier in self.tiers:
+            rec = tier.request_record(trace_id)
+            if rec is not None:
+                return rec
+        return None
 
     # Prefix-pool counters aggregate across tiers (each tier owns its
     # own pool — tiers share no mutable host state, docs/threading.md).

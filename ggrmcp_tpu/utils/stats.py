@@ -18,3 +18,11 @@ def nearest_rank(vals: list[float], p: float) -> float:
     vals = sorted(vals)
     idx = max(0, -(-len(vals) * p // 1) - 1)
     return vals[min(len(vals) - 1, int(idx))]
+
+
+def pct(vals: list[float], p: float) -> float:
+    """nearest_rank rounded to 2 decimals — the one reporting wrapper
+    for every percentile the project exports (batcher lat/stall
+    percentiles, bench extras, flight-recorder request records), so a
+    rounding-policy change can never fork between surfaces."""
+    return round(nearest_rank(vals, p), 2)

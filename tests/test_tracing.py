@@ -10,6 +10,10 @@ import pytest
 from ggrmcp_tpu.utils import tracing
 from ggrmcp_tpu.utils.tracing import Tracer
 
+# Part of the observability net (make test-obs) alongside
+# tests/test_observability.py; still tier-1 (not slow).
+pytestmark = pytest.mark.obs
+
 
 class TestTracer:
     def test_span_records_duration_and_attrs(self):
