@@ -5,8 +5,8 @@ PROTOC ?= protoc
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: proto descriptors test test-all test-fast test-chaos test-obs \
-  bench-cpu smoke e2e lint ci-local preflight clean
+.PHONY: proto proto-check descriptors test test-all test-fast test-chaos \
+  test-obs test-grammar bench-cpu smoke e2e lint ci-local preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
 # No protoc on this image? scripts/regen_serving_pb2.py regenerates
@@ -14,6 +14,12 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 # --check mode runs in the obs test suite, so drift is a red test).
 proto:
 	$(PROTOC) -Iprotos --python_out=ggrmcp_tpu/rpc/pb protos/*.proto
+
+# Drift gate (no protoc needed): fails when serving_pb2.py is stale vs
+# protos/serving.proto. Also runs inside the obs test suite, so CI
+# catches it either way.
+proto-check:
+	$(PY) scripts/regen_serving_pb2.py --check
 
 # Test fixtures: FileDescriptorSets with source info (comment extraction).
 descriptors:
@@ -48,6 +54,13 @@ test-chaos:
 # target is the fast inner loop when touching metrics/tracing.
 test-obs:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m obs
+
+# Schema-constrained decoding net alone (CPU mesh): grammar compiler,
+# table arena, masked-sampling parity, constrained batcher/sidecar/
+# gateway end-to-end, grammar×chaos bit-identity. Tier-1 runs these
+# too; this target is the fast inner loop for ggrmcp_tpu/grammar work.
+test-grammar:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m grammar
 
 # CPU smoke of the full bench, including the mixed long-prompt+decode
 # workload phase (interleaved prefill on — A/B the serialized baseline

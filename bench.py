@@ -914,6 +914,110 @@ async def _run_bench() -> dict:
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: mixed phase failed: {exc!r}", file=sys.stderr)
 
+        # Grammar-constrained decode A/B (GGRMCP_BENCH_GRAMMAR=on|off,
+        # docs/structured_output.md): the same calls with and without a
+        # bounded JSON-schema constraint. Constrained calls usually
+        # finish EARLY (grammar_complete at the DFA sink), so the
+        # honest overhead number is per-TOKEN latency, not per-call;
+        # the artifact exports both plus the sidecar's
+        # grammar_masked_tokens counter for the phase.
+        grammar = {}
+        try:
+            if headline_only or os.environ.get(
+                "GGRMCP_BENCH_GRAMMAR", "on"
+            ) == "off":
+                raise _SkipPhase()
+            g_schema = json.dumps({
+                "type": "object",
+                "properties": {
+                    "verdict": {"enum": ["yes", "no", "maybe"]},
+                    "score": {"type": "number"},
+                    "tags": {
+                        "type": "array",
+                        "items": {"enum": ["a", "b", "c"]},
+                        "maxItems": 3,
+                    },
+                },
+                "required": ["verdict", "score", "tags"],
+            })
+
+            # Own token budget: the schema's canonical output runs ~40-80
+            # bytes, so the headline's (possibly tiny) max_new would cut
+            # constrained calls at "length" with unterminated JSON.
+            g_budget = max(max_new, 128)
+
+            async def g_call(i: int, constrained: bool):
+                """(seconds, completion_tokens) for one call."""
+                args = {
+                    "prompt": f"grammar probe {i}",
+                    "maxNewTokens": g_budget,
+                }
+                if constrained:
+                    args["constraint"] = {"jsonSchema": g_schema}
+                body = {
+                    "jsonrpc": "2.0", "method": "tools/call",
+                    "id": 90000 + i + (10000 if constrained else 0),
+                    "params": {"name": tool, "arguments": args},
+                }
+                t = time.perf_counter()
+                resp = await client.post("/", json=body)
+                data = await resp.json()
+                dt = time.perf_counter() - t
+                if "error" in data:
+                    raise RuntimeError(
+                        f"grammar call failed: {data['error']}"
+                    )
+                payload = json.loads(data["result"]["content"][0]["text"])
+                if constrained:
+                    json.loads(payload["text"])  # the whole point
+                return dt, int(payload.get("completionTokens", 0))
+
+            # Warm both paths off the clock (schema compile + table
+            # upload land here, not on the measured calls).
+            await g_call(0, False)
+            await g_call(0, True)
+            masked0 = int(
+                sidecar.batcher.stats().get("grammar_masked_tokens", 0)
+            )
+            n_g = 8
+            runs = {}
+            for constrained in (False, True):
+                samples = [
+                    await g_call(1 + i, constrained) for i in range(n_g)
+                ]
+                per_tok = [
+                    s / max(1, n_tok) * 1000.0 for s, n_tok in samples
+                ]
+                runs[constrained] = {
+                    "p50_ms": round(
+                        statistics.median(s for s, _ in samples) * 1000, 1
+                    ),
+                    "ms_per_token": round(statistics.median(per_tok), 3),
+                }
+            off, on = runs[False], runs[True]
+            masked = int(
+                sidecar.batcher.stats().get("grammar_masked_tokens", 0)
+            ) - masked0
+            grammar = {
+                "grammar_calls": n_g,
+                "grammar_off_p50_ms": off["p50_ms"],
+                "grammar_on_p50_ms": on["p50_ms"],
+                "grammar_off_ms_per_token": off["ms_per_token"],
+                "grammar_on_ms_per_token": on["ms_per_token"],
+                "grammar_overhead_ms_per_token": round(
+                    on["ms_per_token"] - off["ms_per_token"], 3
+                ),
+                "grammar_overhead_pct": round(
+                    (on["ms_per_token"] / off["ms_per_token"] - 1.0)
+                    * 100.0, 1,
+                ) if off["ms_per_token"] > 0 else 0.0,
+                "grammar_masked_tokens": masked,
+            }
+        except _SkipPhase:
+            pass
+        except Exception as exc:  # secondary phase must not sink the run
+            print(f"bench: grammar phase failed: {exc!r}", file=sys.stderr)
+
     # Per-tick timing breakdown (round-4 verdict #1c: show where the
     # milliseconds live — host dispatch vs device compute/transfer vs
     # admission — so the RTT-bound hypothesis is checkable from the
@@ -994,7 +1098,8 @@ async def _run_bench() -> dict:
         except Exception as exc:  # secondary metric must not sink the run
             print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
     return {
-        **headline, **hbm, **prefix, **longp, **mixed, **ticktime, **proxy,
+        **headline, **hbm, **prefix, **longp, **mixed, **grammar,
+        **ticktime, **proxy,
     }
 
 

@@ -444,6 +444,45 @@ class ObservabilityConfig:
 
 
 @dataclass
+class GrammarConfig:
+    """Schema-constrained decoding (ggrmcp_tpu/grammar): compile MCP
+    tool output schemas into token-level DFAs and enforce them
+    on-device during decode (GenerateRequest.constraint). Disabled,
+    constrained requests are refused with INVALID_ARGUMENT and the
+    batcher's table arena shrinks to the single accept-all state."""
+
+    enabled: bool = True
+    # Per-schema DFA state budget: compilation of a schema whose DFA
+    # exceeds this raises a typed SchemaTooComplexError (the caller's
+    # error, surfaced as INVALID_ARGUMENT — never a 500).
+    max_states: int = 1024
+    # Device table arena rows shared by ALL live grammars per batcher
+    # (state 0 is the reserved accept-all state). HBM cost is
+    # arena_states x vocab x 5 bytes (bool mask + int32 transition) —
+    # ~5 MB at 4096 x 259. Too many DISTINCT schemas decoding at once
+    # raises GrammarCapacityError (RESOURCE_EXHAUSTED).
+    arena_states: int = 4096
+    # Sidecar-side LRU of compiled DFAs, keyed by canonical schema hash.
+    cache_entries: int = 32
+
+
+@dataclass
+class GatewayConfig:
+    """Gateway-side behavior knobs (no reference analogue)."""
+
+    # Per-tool structured-output opt-in: MCP tool name → source of the
+    # schema to enforce on that tool's generated text. "self" (or "")
+    # enforces the tool's OWN output schema; any other value names a
+    # discovered tool whose output schema to enforce. The gateway
+    # inlines the resolved schema into GenerateRequest.constraint on
+    # every call to the tool; only tools whose input message carries a
+    # `constraint` field (the TPU Generate surface) are eligible.
+    # Callers can also pass `constraint.toolOutputSchemaRef` per call —
+    # the gateway resolves it the same way.
+    structured_output: dict = field(default_factory=dict)
+
+
+@dataclass
 class ServingConfig:
     model: str = "tiny-llama"  # registry key in ggrmcp_tpu.models
     dtype: str = "bfloat16"
@@ -527,6 +566,8 @@ class ServingConfig:
     observability: "ObservabilityConfig" = field(
         default_factory=lambda: ObservabilityConfig()
     )
+    # Schema-constrained decoding (DFA logit masking) — GrammarConfig.
+    grammar: "GrammarConfig" = field(default_factory=lambda: GrammarConfig())
 
 
 @dataclass
@@ -572,6 +613,7 @@ class Config:
     mcp: MCPConfig = field(default_factory=MCPConfig)
     session: SessionConfig = field(default_factory=SessionConfig)
     tools: ToolsConfig = field(default_factory=ToolsConfig)
+    gateway: GatewayConfig = field(default_factory=GatewayConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     training: TrainingConfig = field(default_factory=TrainingConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
@@ -691,6 +733,27 @@ class Config:
             raise ValueError(
                 "observability.bucket_bounds_ms must be strictly "
                 "ascending positive values"
+            )
+        grammar = self.serving.grammar
+        if grammar.max_states < 2:
+            raise ValueError("grammar.max_states must be >= 2")
+        if grammar.arena_states < grammar.max_states + 1:
+            # State 0 is reserved (accept-all); the arena must hold at
+            # least one maximal compiled schema beside it.
+            raise ValueError(
+                "grammar.arena_states must be > grammar.max_states "
+                "(state 0 is the reserved accept-all state)"
+            )
+        if grammar.cache_entries < 1:
+            raise ValueError("grammar.cache_entries must be >= 1")
+        so = self.gateway.structured_output
+        if not isinstance(so, dict) or not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in so.items()
+        ):
+            raise ValueError(
+                "gateway.structured_output must map tool names to "
+                "'self' (or '') or another tool name"
             )
         if self.serving.speculative_gamma < 1:
             raise ValueError("speculative_gamma must be >= 1")

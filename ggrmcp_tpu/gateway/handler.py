@@ -306,12 +306,85 @@ class MCPHandler:
         tools = self.tool_builder.build_tools(methods)
         return {"tools": [t.to_dict() for t in tools]}
 
+    def _apply_structured_output(
+        self, tool_name: str, arguments: Any
+    ) -> Any:
+        """Schema-constrained tool output (gateway.structured_output +
+        ggrmcp_tpu/grammar): resolve which output schema — if any — the
+        backend must enforce on this call's generated text, and inline
+        it into the arguments as `constraint.jsonSchema`.
+
+        Two triggers: the caller passed
+        `constraint.toolOutputSchemaRef = <tool>` (per-call), or the
+        operator opted the tool in via gateway.structured_output
+        (tool name → "self"/"" for its own output schema, or another
+        tool's name). The sidecar has no tool registry, so the ref is
+        resolved HERE, where the schema builder lives. Only tools whose
+        input message carries a `constraint` field (the TPU Generate
+        surface) are eligible — anything else passes through untouched
+        rather than failing proto transcoding."""
+        if not isinstance(arguments, dict):
+            return arguments
+        constraint = arguments.get("constraint")
+        ref = None
+        if isinstance(constraint, dict):
+            ref = constraint.get("toolOutputSchemaRef") or constraint.get(
+                "tool_output_schema_ref"
+            )
+            if not ref:
+                return arguments  # inline schema (or empty): pass through
+        elif constraint is None:
+            gateway_cfg = getattr(self.cfg, "gateway", None)
+            configured = (
+                gateway_cfg.structured_output.get(tool_name)
+                if gateway_cfg is not None else None
+            )
+            if configured is None:
+                return arguments
+            ref = configured or "self"
+        else:
+            return arguments
+        try:
+            method = self.discoverer.get_method_by_tool(tool_name)
+        except ToolNotFoundError:
+            return arguments  # invoke will surface the real error
+        desc = method.input_descriptor
+        if desc is None or "constraint" not in desc.fields_by_name:
+            if isinstance(constraint, dict):
+                raise mcp.MCPError(
+                    mcp.INVALID_PARAMS,
+                    f"tool {tool_name} does not accept an output "
+                    "constraint",
+                )
+            return arguments  # config opt-in on a non-generate tool: skip
+        target = tool_name if ref == "self" else ref
+        try:
+            source = self.discoverer.get_method_by_tool(target)
+        except ToolNotFoundError:
+            raise mcp.MCPError(
+                mcp.INVALID_PARAMS,
+                f"structured_output: unknown schema source tool {target!r}",
+            )
+        schema = self.tool_builder.build_tool(source).output_schema
+        if not schema:
+            raise mcp.MCPError(
+                mcp.INVALID_PARAMS,
+                f"structured_output: tool {target!r} has no output schema",
+            )
+        new_constraint = {
+            k: v for k, v in (constraint or {}).items()
+            if k not in ("toolOutputSchemaRef", "tool_output_schema_ref")
+        }
+        new_constraint["jsonSchema"] = json.dumps(schema)
+        return {**arguments, "constraint": new_constraint}
+
     async def _handle_tools_call(
         self,
         session: SessionContext,
         params: Any,
     ) -> dict[str, Any]:
         tool_name, arguments = self.validator.validate_tool_call_params(params)
+        arguments = self._apply_structured_output(tool_name, arguments)
         headers = self._metadata_with_trace(session)
         start = time.perf_counter()
         try:
@@ -413,6 +486,7 @@ class MCPHandler:
         `sse` opens the stream and writes events (aiohttp StreamResponse
         or the fast lane's raw socket writer)."""
         tool_name, arguments = self.validator.validate_tool_call_params(params)
+        arguments = self._apply_structured_output(tool_name, arguments)
         headers = self._metadata_with_trace(session)
         await sse.start(session.id, trace_id)
         start = time.perf_counter()

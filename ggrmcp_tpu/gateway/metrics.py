@@ -70,6 +70,12 @@ _SERVING_HELP = {
         "requests requeued with a replay prefix after a failed tick",
     "replay_exhausted":
         "requests that exhausted tick_retry_limit and errored",
+    "grammar_compiles": "schema-to-DFA grammar compiles",
+    "grammar_cache_hits": "grammar compile-cache hits",
+    "grammar_masked_tokens":
+        "tokens emitted under an active grammar mask",
+    "grammar_states_in_use":
+        "DFA states resident in the grammar table arena",
 }
 
 _SERVING_HIST_HELP = {

@@ -1,0 +1,43 @@
+"""Schema-constrained decoding for MCP tool outputs.
+
+Compile a JSON schema (the dialect schema/builder.py emits for MCP
+tools) into a token-level DFA over the serving tokenizer and enforce it
+on-device during decode — malformed tool output becomes impossible by
+construction instead of a validation failure at the last hop.
+
+- compiler: schema → regex IR → byte DFA → dense [n_states, V] token
+  tables (CompiledGrammar), with typed errors for unsupported dialect
+  and over-budget schemas.
+- runtime: GrammarCache (LRU of compiled DFAs, sidecar-owned) and
+  GrammarArena (the fixed-shape shared device tables + per-grammar
+  residency/refcounts, batcher-owned).
+
+Device-side enforcement lives in ops/sampling.py::masked_sample_dynamic
+and is threaded through every sampling site of the continuous batcher
+(serving/batching.py); the wire contract is GenerateRequest.constraint
+(protos/serving.proto). docs/structured_output.md is the operator guide.
+"""
+
+from ggrmcp_tpu.grammar.compiler import (
+    CompiledGrammar,
+    GrammarCapacityError,
+    GrammarError,
+    SchemaTooComplexError,
+    SchemaUnsupportedError,
+    compile_schema,
+    schema_fingerprint,
+)
+from ggrmcp_tpu.grammar.runtime import GrammarArena, GrammarCache, GrammarHandle
+
+__all__ = [
+    "CompiledGrammar",
+    "GrammarArena",
+    "GrammarCache",
+    "GrammarCapacityError",
+    "GrammarError",
+    "GrammarHandle",
+    "SchemaTooComplexError",
+    "SchemaUnsupportedError",
+    "compile_schema",
+    "schema_fingerprint",
+]

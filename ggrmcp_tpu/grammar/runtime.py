@@ -1,0 +1,230 @@
+"""Grammar runtime state: the sidecar's compile cache and the
+batcher's device-table arena.
+
+GrammarCache — an LRU of CompiledGrammar keyed by canonical schema
+hash, so a tool whose output schema is enforced on every call compiles
+its DFA once (counters feed the ``grammar_compiles`` /
+``grammar_cache_hits`` ServingStats fields).
+
+GrammarArena — the fixed-shape host mirror of the device tables the
+jitted tick consumes. All LIVE grammars share ONE ``[arena_states, V]``
+allow-mask + transition table: each acquired grammar gets a contiguous
+state range (its local transitions relocate by plain offset because
+disallowed transitions are self-loops), per-row decode state is an
+absolute index into the arena, and row/state 0 is the reserved
+universal accept-all state unconstrained rows carry — which is what
+lets mixed constrained/unconstrained batches share one compiled
+function with zero recompiles. The FIXED shape is the point: a new
+schema changes table *contents* (one host→device upload), never table
+*shape*, so the tick's XLA program is compiled exactly once.
+
+Threading: acquire() runs on the event loop (submit), release() on
+either the loop or the batcher's executor (terminal paths) — a small
+lock guards the entry map and refcounts. The numpy tables are written
+only under that lock; the batcher snapshots them (also under the lock)
+when the version counter moves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ggrmcp_tpu.grammar.compiler import (
+    CompiledGrammar,
+    GrammarCapacityError,
+    GrammarError,
+    compile_schema,
+    schema_fingerprint,
+)
+
+
+class GrammarCache:
+    """LRU of compiled DFAs keyed by canonical schema hash."""
+
+    def __init__(self, max_entries: int = 32):
+        self.max_entries = max(1, int(max_entries))
+        self._entries: dict[str, CompiledGrammar] = {}
+        self._stamp: dict[str, int] = {}
+        self._clock = 0
+        self._lock = threading.Lock()
+        self.compiles = 0
+        self.hits = 0
+
+    def get(
+        self,
+        schema: "str | dict",
+        vocab_size: int,
+        eos_id: int = 2,
+        max_states: int = 1024,
+        byte_offset: int = 3,
+    ) -> CompiledGrammar:
+        key = schema_fingerprint(schema)
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self.hits += 1
+                self._clock += 1
+                self._stamp[key] = self._clock
+                return hit
+        # Compile outside the lock (pure host work, possibly slow);
+        # a racing duplicate compile is wasted work, not corruption.
+        compiled = compile_schema(
+            schema, vocab_size, eos_id=eos_id, max_states=max_states,
+            byte_offset=byte_offset,
+        )
+        with self._lock:
+            if key not in self._entries:
+                self.compiles += 1
+                if len(self._entries) >= self.max_entries:
+                    victim = min(self._stamp, key=self._stamp.get)
+                    del self._entries[victim]
+                    del self._stamp[victim]
+                self._entries[key] = compiled
+            self._clock += 1
+            self._stamp[key] = self._clock
+            return self._entries[key]
+
+
+@dataclasses.dataclass
+class GrammarHandle:
+    """A live grammar's residency in one arena: absolute state range
+    [base, base+n) and the compiled artifact. Host-side stepping goes
+    through the ARENA tables (absolute states), not the local ones."""
+
+    grammar: CompiledGrammar
+    base: int
+
+    @property
+    def start(self) -> int:
+        return self.base + self.grammar.start
+
+
+class GrammarArena:
+    """Fixed-shape shared token tables for all live grammars.
+
+    State 0 is the universal accept-all state (allow everything,
+    self-transition) that unconstrained rows carry. Grammars are
+    acquired with a refcount; zero-ref entries stay resident (warm
+    cache) and are evicted LRU-first only when a new grammar needs
+    their rows. `version` increments on every table mutation so the
+    batcher knows when to re-upload to device.
+    """
+
+    def __init__(self, max_states: int, vocab_size: int):
+        self.max_states = max(2, int(max_states))
+        self.vocab_size = int(vocab_size)
+        self.allow = np.zeros((self.max_states, self.vocab_size), dtype=bool)
+        self.allow[0, :] = True  # state 0: unconstrained rows
+        self.trans = np.zeros((self.max_states, self.vocab_size), np.int32)
+        self.sink = np.zeros((self.max_states,), dtype=bool)
+        self.version = 1
+        self._lock = threading.Lock()
+        # schema hash → [handle-agnostic entry]
+        self._entries: dict[str, dict] = {}
+        self._clock = 0
+
+    # -- queries ------------------------------------------------------------
+
+    def states_in_use(self) -> int:
+        with self._lock:
+            return 1 + sum(e["n"] for e in self._entries.values())
+
+    def step(self, state: int, token: int) -> int:
+        """Host-side transition on ABSOLUTE state ids (per-token emit
+        tracking and replay re-derivation). Lock-free: rows of live
+        entries are immutable while referenced."""
+        return int(self.trans[state, int(token)])
+
+    def is_sink(self, state: int) -> bool:
+        return bool(self.sink[state])
+
+    def snapshot(self) -> tuple[np.ndarray, np.ndarray, int]:
+        """(allow copy, trans copy, version) for device upload —
+        copied under the lock so an in-flight acquire can't tear it."""
+        with self._lock:
+            return self.allow.copy(), self.trans.copy(), self.version
+
+    # -- residency ----------------------------------------------------------
+
+    def acquire(self, grammar: CompiledGrammar) -> GrammarHandle:
+        """Make `grammar` resident (inserting its tables if needed) and
+        take a reference. Raises GrammarCapacityError when the arena
+        cannot fit it even after evicting every zero-ref entry."""
+        if grammar.vocab_size != self.vocab_size:
+            raise GrammarError(
+                f"grammar compiled for vocab {grammar.vocab_size}, "
+                f"arena serves vocab {self.vocab_size}"
+            )
+        with self._lock:
+            self._clock += 1
+            entry = self._entries.get(grammar.schema_hash)
+            if entry is not None:
+                entry["refs"] += 1
+                entry["stamp"] = self._clock
+                return GrammarHandle(grammar=grammar, base=entry["base"])
+            n = grammar.n_states
+            if n > self.max_states - 1:
+                raise GrammarCapacityError(
+                    f"grammar needs {n} states; arena holds "
+                    f"{self.max_states - 1} (serving.grammar.arena_states)"
+                )
+            base = self._find_gap(n)
+            if base is None:
+                self._evict_idle(n)
+                base = self._find_gap(n)
+            if base is None:
+                raise GrammarCapacityError(
+                    "grammar table arena full: too many distinct "
+                    "schemas decoding at once "
+                    "(serving.grammar.arena_states)"
+                )
+            self.allow[base:base + n] = grammar.allow
+            self.trans[base:base + n] = grammar.trans + base
+            self.sink[base:base + n] = grammar.sink
+            self.version += 1
+            self._entries[grammar.schema_hash] = {
+                "base": base, "n": n, "refs": 1, "stamp": self._clock,
+            }
+            return GrammarHandle(grammar=grammar, base=base)
+
+    def release(self, handle: Optional[GrammarHandle]) -> None:
+        if handle is None:
+            return
+        with self._lock:
+            entry = self._entries.get(handle.grammar.schema_hash)
+            if entry is not None and entry["refs"] > 0:
+                entry["refs"] -= 1
+
+    # -- internals (lock held) ----------------------------------------------
+
+    def _find_gap(self, n: int) -> Optional[int]:
+        """First contiguous free range of >= n states after state 0."""
+        used = sorted(
+            (e["base"], e["base"] + e["n"]) for e in self._entries.values()
+        )
+        cursor = 1
+        for start, end in used:
+            if start - cursor >= n:
+                return cursor
+            cursor = max(cursor, end)
+        if self.max_states - cursor >= n:
+            return cursor
+        return None
+
+    def _evict_idle(self, need: int) -> None:
+        """Drop zero-ref entries LRU-first until a `need`-state gap
+        exists (or none are left). Evicted rows need no zeroing: no
+        live row's state can point into an unreferenced entry."""
+        idle = sorted(
+            (k for k, e in self._entries.items() if e["refs"] == 0),
+            key=lambda k: self._entries[k]["stamp"],
+        )
+        for key in idle:
+            del self._entries[key]
+            self.version += 1
+            if self._find_gap(need) is not None:
+                return

@@ -35,6 +35,55 @@ def sample(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def dynamic_support_mask(
+    logits: jnp.ndarray,  # [B, V]
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+) -> jnp.ndarray:  # [B, V] bool
+    """Tokens `sample_dynamic` can draw under the given per-row params
+    — exposed so tests/test_sampling.py can hold the dynamic path to
+    the STATIC path's boundary semantics (sample() = temperature scale,
+    then top-k, then top-p over the top-k-renormalized distribution)
+    without sampling-based set reconstruction. The grammar mask
+    composes upstream of this (masked_sample_dynamic): disallowed
+    tokens arrive as -inf and can never enter the kept set with a
+    finite threshold."""
+    logits = logits.astype(jnp.float32)
+    v = logits.shape[-1]
+    # Temperature scales BEFORE the nucleus test, like the static
+    # path's warper order (and HF's): top-p is a statement about the
+    # distribution actually sampled from.
+    safe_temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / safe_temp
+
+    sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]  # desc
+    rank = jnp.arange(v)[None, :]
+    # top-k: keep ranks < k (k==0 → keep all)
+    k = jnp.where(top_k[:, None] > 0, top_k[:, None], v)
+    keep_k = rank < k
+    # top-p over the distribution RENORMALIZED within the top-k kept
+    # tokens — the static path applies _mask_top_p to the already
+    # top-k-masked logits. With top_k disabled this is a no-op.
+    probs = jax.nn.softmax(
+        jnp.where(keep_k, sorted_logits, -jnp.inf), axis=-1
+    )
+    cumulative = jnp.cumsum(probs, axis=-1)
+    # keep while mass before < p. p >= 1 disables the test OUTRIGHT
+    # (static parity): the arithmetic form alone drops tail tokens
+    # whose probability rounds below float32 eps, because
+    # cumulative - probs lands exactly on 1.0 there.
+    keep_p = (
+        (cumulative - probs) < jnp.minimum(top_p, 1.0)[:, None]
+    ) | (top_p[:, None] >= 1.0)
+    keep = keep_k & keep_p
+    keep = keep.at[:, 0].set(True)  # always ≥ 1 token
+    # threshold = smallest kept logit per row
+    kept_count = keep.sum(axis=-1, keepdims=True)
+    threshold = jnp.take_along_axis(sorted_logits, kept_count - 1, axis=-1)
+    return scaled >= threshold
+
+
 def sample_dynamic(
     logits: jnp.ndarray,  # [B, V]
     seeds: jnp.ndarray,  # [B] uint32/int — per-request seeds
@@ -47,27 +96,9 @@ def sample_dynamic(
     path, where each slot carries its own sampling config and seed.
     One full sort per row replaces static top-k/top-p masking."""
     logits = logits.astype(jnp.float32)
-    v = logits.shape[-1]
-
-    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # desc
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cumulative = jnp.cumsum(probs, axis=-1)
-    rank = jnp.arange(v)[None, :]
-
-    # top-k: keep ranks < k (k==0 → keep all)
-    k = jnp.where(top_k[:, None] > 0, top_k[:, None], v)
-    keep_k = rank < k
-    # top-p: keep while mass before < p (p>=1 → keep all)
-    keep_p = (cumulative - probs) < jnp.minimum(top_p, 1.0)[:, None]
-    keep = keep_k & keep_p
-    keep = keep.at[:, 0].set(True)  # always ≥ 1 token
-    # threshold = smallest kept logit per row
-    kept_count = keep.sum(axis=-1, keepdims=True)
-    threshold = jnp.take_along_axis(sorted_logits, kept_count - 1, axis=-1)
-    masked = jnp.where(logits < threshold, -jnp.inf, logits)
-
+    support = dynamic_support_mask(logits, temperature, top_k, top_p)
     safe_temp = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = masked / safe_temp
+    scaled = jnp.where(support, logits / safe_temp, -jnp.inf)
 
     def row_sample(seed, row_logits):
         key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
@@ -76,6 +107,32 @@ def sample_dynamic(
     sampled = jax.vmap(row_sample)(seeds, scaled).astype(jnp.int32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def masked_sample_dynamic(
+    logits: jnp.ndarray,  # [B, V]
+    seeds: jnp.ndarray,  # [B]
+    step: jnp.ndarray,  # scalar
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    state: jnp.ndarray,  # [B] int32 — per-row grammar state (0 = none)
+    allow: jnp.ndarray,  # [S, V] bool — shared grammar allow-mask
+    trans: jnp.ndarray,  # [S, V] int32 — shared transition table
+) -> tuple[jnp.ndarray, jnp.ndarray]:  # (tokens [B], next state [B])
+    """Grammar-constrained per-row sampling: disallowed tokens are
+    masked to -inf BEFORE temperature/top-k/top-p (the categorical's
+    softmax renormalizes over the survivors), then each row's grammar
+    state advances through the transition table — a gather, so the
+    constrained step stays inside the jitted tick with no host
+    round-trip. State 0 is the universal accept-all state
+    (grammar/runtime.py): unconstrained rows pass through with
+    bit-identical numerics (where(True, x, -inf) == x), which is what
+    lets mixed batches share one compiled function."""
+    masked = jnp.where(allow[state], logits.astype(jnp.float32), -jnp.inf)
+    tokens = sample_dynamic(masked, seeds, step, temperature, top_k, top_p)
+    nxt = jnp.take_along_axis(trans[state], tokens[:, None], axis=-1)[:, 0]
+    return tokens, nxt
 
 
 def _mask_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:

@@ -26,10 +26,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ggrmcp_tpu.core.config import BatchingConfig, resolve_decode_steps
+from ggrmcp_tpu.core.config import (
+    BatchingConfig,
+    GrammarConfig,
+    resolve_decode_steps,
+)
+from ggrmcp_tpu.grammar.compiler import CompiledGrammar
+from ggrmcp_tpu.grammar.runtime import GrammarArena, GrammarHandle
 from ggrmcp_tpu.models import llama as llama_mod
 from ggrmcp_tpu.ops import quant
-from ggrmcp_tpu.ops.sampling import SamplingConfig, sample_dynamic
+from ggrmcp_tpu.ops.sampling import (
+    SamplingConfig,
+    masked_sample_dynamic,
+    sample_dynamic,
+)
 from ggrmcp_tpu.serving.engine import bucket_len, fit_request
 from ggrmcp_tpu.serving.flight_recorder import FlightRecorder
 from ggrmcp_tpu.utils import failpoints
@@ -192,6 +202,14 @@ class _Request:
     t_first: float = 0.0
     n_prompt: int = 0
     first_tick: int = -1
+    # Schema-constrained decoding (ggrmcp_tpu/grammar): the live arena
+    # residency (None = unconstrained), the row's current ABSOLUTE DFA
+    # state for host-side sink detection (advanced per emitted token in
+    # _emit_chunk), and whether the arena reference was already
+    # released (terminal paths can be re-entered under races).
+    grammar: Optional[GrammarHandle] = None
+    gcur: int = 0
+    g_released: bool = False
 
 
 class ContinuousBatcher:
@@ -276,6 +294,29 @@ class ContinuousBatcher:
         # host mirror trails by a tick and only seeds rebuilds.
         self.cur_tokens = np.zeros((b,), np.int32)
         self._cur_dev = None  # lazily jnp.asarray(cur_tokens)
+        # Grammar-constrained decoding (ggrmcp_tpu/grammar): per-slot
+        # ABSOLUTE DFA state (0 = the arena's universal accept-all
+        # state — unconstrained rows), with the same host-mirror /
+        # device-twin split as cur_tokens: the tick feeds the previous
+        # tick's output states back on device, admission patches single
+        # entries eagerly, the mirror only seeds rebuilds. The arena's
+        # [arena_states, V] allow/transition tables ride every sampling
+        # call as FIXED-shape arguments, so a new schema never
+        # recompiles the tick — it only re-uploads table contents
+        # (_grammar_tables).
+        self.gstates = np.zeros((b,), np.int32)
+        self._gstate_dev = None
+        gcfg = getattr(engine.serving, "grammar", None) or GrammarConfig()
+        self.arena = GrammarArena(
+            gcfg.arena_states if gcfg.enabled else 2,
+            engine.cfg.vocab_size,
+        )
+        self._g_allow_dev = None
+        self._g_trans_dev = None
+        self._g_dev_version = -1
+        # Tokens emitted under an active grammar mask (the
+        # grammar_masked_tokens ServingStats field).
+        self.grammar_tokens = 0
         self.temps = np.zeros((b,), np.float32)
         self.top_ks = np.zeros((b,), np.int32)
         self.top_ps = np.ones((b,), np.float32)
@@ -440,14 +481,55 @@ class ContinuousBatcher:
             self.engine.cfg, rows, length, self.engine.kv_dtype
         )
 
+    # -- grammar host side (serving/batching owns residency + states) -------
+
+    def _grammar_tables(self):
+        """Device copies of the arena's allow/transition tables,
+        re-uploaded only when a grammar was inserted or evicted since
+        the last call (arena.version). FIXED [arena_states, V] shape:
+        table-content churn never recompiles a device program."""
+        if (
+            self._g_allow_dev is None
+            or self._g_dev_version != self.arena.version
+        ):
+            allow, trans, version = self.arena.snapshot()
+            self._g_allow_dev = jnp.asarray(allow)
+            self._g_trans_dev = jnp.asarray(trans)
+            self._g_dev_version = version
+        return self._g_allow_dev, self._g_trans_dev
+
+    def _g0(self, request: _Request) -> int:
+        """The ABSOLUTE grammar state a (re-)admission samples its
+        first token under. Fresh requests start at the grammar's start
+        state; tick-failure replays re-derive it by replaying the
+        absorbed emitted tokens through the transition table — which is
+        what keeps constrained greedy output bit-identical under the
+        chaos suite (the re-admitted prefill of prompt+acc continues
+        from exactly the state the consumer last observed)."""
+        if request.grammar is None:
+            return 0
+        state = request.grammar.start
+        for token in request.acc[:request.absorbed]:
+            state = self.arena.step(state, int(token))
+        return state
+
+    def _grammar_release(self, request: _Request) -> None:
+        """Return a terminal request's arena reference (idempotent —
+        several terminal paths can observe the same request)."""
+        if request.grammar is not None and not request.g_released:
+            request.g_released = True
+            self.arena.release(request.grammar)
+
     # -- jitted bodies ------------------------------------------------------
 
     def _prefill_sample(
-        self, params, tokens, true_len, seeds, temps, ks, ps, adapters
+        self, params, tokens, true_len, seeds, temps, ks, ps, adapters,
+        g0, g_allow, g_trans,
     ):
         """Shared admission core: prefill the right-padded prompts
         [R, S] against a fresh mini cache, sample each row's first
-        token. Returns (first [R], mini cache)."""
+        token (grammar-masked under each row's admission state `g0`;
+        0 = unconstrained). Returns (first [R], mini cache)."""
         r, s = tokens.shape
         mini = self._make_mini(r, s)
         # Fresh prefill → engine.prefill_forward (handles MoE validity
@@ -457,23 +539,25 @@ class ContinuousBatcher:
             params, tokens, mini, valid=valid, lora_idx=adapters
         )
         first = self._first_token_impl(
-            logits, jnp.maximum(true_len - 1, 0), seeds, temps, ks, ps
+            logits, jnp.maximum(true_len - 1, 0), seeds, temps, ks, ps,
+            g0, g_allow, g_trans,
         )
         return first, mini
 
     def _admit_single_impl(
         self, params, tokens, true_len, cache, slot, seeds, temps, ks, ps,
-        adapters,
+        adapters, g0, g_allow, g_trans,
     ):
         """Admit ONE request (row shapes [1, S]) into slot `slot`."""
         first, mini = self._prefill_sample(
-            params, tokens, true_len, seeds, temps, ks, ps, adapters
+            params, tokens, true_len, seeds, temps, ks, ps, adapters,
+            g0, g_allow, g_trans,
         )
         return first, _merge_row(cache, mini, slot, true_len[0])
 
     def _admit_full_impl(
         self, params, tokens, true_len, cache, valid, seeds, temps, ks, ps,
-        adapters,
+        adapters, g0, g_allow, g_trans,
     ):
         """Admit a burst in one call: `tokens` is a full [B, S] batch
         with admitted prompts placed at their slots' rows and
@@ -481,7 +565,8 @@ class ContinuousBatcher:
         row-select, not a scatter, so no duplicate-index hazards)."""
         s = tokens.shape[1]
         first, mini = self._prefill_sample(
-            params, tokens, true_len, seeds, temps, ks, ps, adapters
+            params, tokens, true_len, seeds, temps, ks, ps, adapters,
+            g0, g_allow, g_trans,
         )
         sel = valid[None, :, None, None, None]
 
@@ -533,13 +618,16 @@ class ContinuousBatcher:
         return fl, mini
 
     def _chunked_finish(
-        self, cache, mini, slots, true_len, fl, seeds, temps, ks, ps
+        self, cache, mini, slots, true_len, fl, seeds, temps, ks, ps,
+        g0, g_allow, g_trans,
     ):
         """Scatter the [R, S_max] admission mini into the shared cache
         at `slots` (padding rows carry an out-of-range slot index and
         are DROPPED by the scatter — real slots are distinct, so no
         duplicate-index hazards) and sample each row's first token."""
-        first = sample_dynamic(fl, seeds, jnp.int32(0), temps, ks, ps)
+        first, _ = masked_sample_dynamic(
+            fl, seeds, jnp.int32(0), temps, ks, ps, g0, g_allow, g_trans
+        )
 
         def put(c_, m):
             return c_.at[:, slots].set(m.astype(c_.dtype), mode="drop")
@@ -551,7 +639,7 @@ class ContinuousBatcher:
 
     def _admit_chunked_impl(
         self, params, tokens, true_len, cache, slots, seeds, temps, ks,
-        ps, adapters,
+        ps, adapters, g0, g_allow, g_trans,
     ):
         """Fused chunked admission (no prefix): the whole [R, T, C]
         prefill grid + merge + first-token sample, ONE device call.
@@ -564,12 +652,13 @@ class ContinuousBatcher:
             params, tokens, true_len, mini, adapters, jnp.int32(0)
         )
         return self._chunked_finish(
-            cache, mini, slots, true_len, fl, seeds, temps, ks, ps
+            cache, mini, slots, true_len, fl, seeds, temps, ks, ps,
+            g0, g_allow, g_trans,
         )
 
     def _admit_chunked_pfx_impl(
         self, params, tokens, true_len, cache, slots, seeds, temps, ks,
-        ps, adapters, pool, entry, start,
+        ps, adapters, pool, entry, start, g0, g_allow, g_trans,
     ):
         """Fused prefix-reuse admission: pool entry `entry` seeds the
         first `start` positions of EVERY row, then the [R, 1, W] suffix
@@ -596,37 +685,45 @@ class ContinuousBatcher:
             params, tokens, true_len, mini, adapters, start
         )
         return self._chunked_finish(
-            cache, mini, slots, true_len, fl, seeds, temps, ks, ps
+            cache, mini, slots, true_len, fl, seeds, temps, ks, ps,
+            g0, g_allow, g_trans,
         )
 
     def _decode_scan(
         self, params, tokens, cache, seeds, step, temps, ks, ps, active,
-        adapters,
+        adapters, gstate, g_allow, g_trans,
     ):
         """`decode_steps_per_tick` fused decode steps (lax.scan) — the
         shared core of the plain tick and the fused tick+chunk program,
         so interleaved admission cannot perturb decode numerics by
-        construction. Returns (toks [B, steps], cache)."""
+        construction. Each step samples through the grammar mask and
+        advances the per-row DFA state via a table gather — the
+        constrained step never leaves the device (rows at state 0, the
+        accept-all state, are numerically untouched). Returns
+        (toks [B, steps], cache, gstate_out [B])."""
 
         def body(carry, i):
-            cur, cache = carry
+            cur, gs, cache = carry
             logits, cache = self.engine.decode_forward(
                 params, cur[:, None], cache,
                 valid=active[:, None] if self._is_moe else None,
                 ring=self._ring,
                 lora_idx=adapters,
             )
-            nxt = sample_dynamic(logits[:, -1], seeds, step + i, temps, ks, ps)
-            return (nxt, cache), nxt
+            nxt, gs = masked_sample_dynamic(
+                logits[:, -1], seeds, step + i, temps, ks, ps,
+                gs, g_allow, g_trans,
+            )
+            return (nxt, gs, cache), nxt
 
-        (_, cache), toks = jax.lax.scan(
-            body, (tokens, cache), jnp.arange(self._steps_per_tick)
+        (_, gstate, cache), toks = jax.lax.scan(
+            body, (tokens, gstate, cache), jnp.arange(self._steps_per_tick)
         )
-        return toks.T, cache  # [B, steps_per_tick]
+        return toks.T, cache, gstate  # [B, steps_per_tick], ..., [B]
 
     def _tick_impl(
         self, params, tokens, cache, seeds, step, temps, ks, ps, active,
-        adapters,
+        adapters, gstate, g_allow, g_trans,
     ):
         """One device call = `decode_steps_per_tick` fused decode steps
         (lax.scan). Fewer host round-trips per token: tokens sampled
@@ -635,12 +732,13 @@ class ContinuousBatcher:
         `length` on slot reuse)."""
         return self._decode_scan(
             params, tokens, cache, seeds, step, temps, ks, ps, active,
-            adapters,
+            adapters, gstate, g_allow, g_trans,
         )
 
     def _tick_chunk_impl(
         self, params, tokens, cache, seeds, step, temps, ks, ps, active,
         adapters, chunk, mini, offs, c_true_len, c_valid, c_adapters,
+        gstate, g_allow, g_trans,
     ):
         """Fused tick+chunk (prefill_interleave=on): the decode scan for
         every slot AND at most one [K, C] prefill chunk for admitting
@@ -657,9 +755,9 @@ class ContinuousBatcher:
         serialized chunked grid: same chunk widths, same offsets, same
         final-position gather — only the batch row count differs, which
         is row-independent math."""
-        toks, cache = self._decode_scan(
+        toks, cache, gstate = self._decode_scan(
             params, tokens, cache, seeds, step, temps, ks, ps, active,
-            adapters,
+            adapters, gstate, g_allow, g_trans,
         )
         mini = mini._replace(length=offs)
         c = chunk.shape[1]
@@ -677,10 +775,11 @@ class ContinuousBatcher:
         last = c_true_len - 1
         idx = jnp.clip(last - offs, 0, c - 1)
         sel = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
-        return toks, cache, mini, sel.astype(jnp.float32)
+        return toks, cache, mini, sel.astype(jnp.float32), gstate
 
     def _ilv_finish_impl(
         self, cache, mini, row, slot, n, sel, seeds, temps, ks, ps,
+        g0, g_allow, g_trans,
     ):
         """Final-chunk completion for one interleaved admission: copy
         mini row `row` into the shared cache at `slot` with true length
@@ -698,7 +797,9 @@ class ContinuousBatcher:
         )
         cache = _merge_row(cache, picked, slot, n)
         fl = jax.lax.dynamic_slice_in_dim(sel, row, 1, axis=0)
-        first = sample_dynamic(fl, seeds, jnp.int32(0), temps, ks, ps)
+        first, _ = masked_sample_dynamic(
+            fl, seeds, jnp.int32(0), temps, ks, ps, g0, g_allow, g_trans
+        )
         return first, cache
 
     def _chunk_step_impl(self, params, tokens, mini, true_len, adapter):
@@ -721,9 +822,14 @@ class ContinuousBatcher:
         `slot` with the row's true length (shared with fused admission)."""
         return _merge_row(cache, mini, slot, length)
 
-    def _first_token_impl(self, logits, idx, seeds, temps, ks, ps):
+    def _first_token_impl(
+        self, logits, idx, seeds, temps, ks, ps, g0, g_allow, g_trans
+    ):
         last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
-        return sample_dynamic(last, seeds, jnp.int32(0), temps, ks, ps)
+        first, _ = masked_sample_dynamic(
+            last, seeds, jnp.int32(0), temps, ks, ps, g0, g_allow, g_trans
+        )
+        return first
 
     def _pfx_store_impl(self, pool, mini, entry, plen):
         """Copy the first `_pfx_max` cache positions of a fully
@@ -1016,12 +1122,14 @@ class ContinuousBatcher:
         self._cache_at_risk = False
         # Last real token sits at n - last_step_offset - 1 of the final
         # step (always < that step's width).
+        g_allow, g_trans = self._grammar_tables()
         first = self._first_token(
             logits, jnp.asarray([n - steps[-1][0] - 1], jnp.int32),
             jnp.asarray([request.seed & 0xFFFFFFFF], jnp.uint32),
             jnp.asarray([request.sampling.temperature], jnp.float32),
             jnp.asarray([request.sampling.top_k], jnp.int32),
             jnp.asarray([request.sampling.top_p], jnp.float32),
+            jnp.asarray([self._g0(request)], jnp.int32), g_allow, g_trans,
         )
         self._activate_slot(slot_idx, request, int(np.asarray(first)[0]))
 
@@ -1045,6 +1153,19 @@ class ContinuousBatcher:
         self.cur_tokens[slot_idx] = first_tok
         if self._cur_dev is not None:
             self._cur_dev = self._cur_dev.at[slot_idx].set(first_tok)
+        # Grammar state: the row's emit tracker starts at the admission
+        # state (the _emit below advances it through first_tok); the
+        # slot's NEXT-tick state is the post-first-token state, patched
+        # into the mirror + device twin like cur_tokens.
+        g0 = self._g0(request)
+        request.gcur = g0
+        g_next = (
+            self.arena.step(g0, first_tok)
+            if request.grammar is not None else 0
+        )
+        self.gstates[slot_idx] = g_next
+        if self._gstate_dev is not None:
+            self._gstate_dev = self._gstate_dev.at[slot_idx].set(g_next)
         self.temps[slot_idx] = request.sampling.temperature
         self.top_ks[slot_idx] = request.sampling.top_k
         self.top_ps[slot_idx] = request.sampling.top_p
@@ -1073,11 +1194,15 @@ class ContinuousBatcher:
         zf1 = np.zeros((1,), np.float32)
         zi1 = np.zeros((1,), np.int32)
         of1 = np.ones((1,), np.float32)
+        # Grammar tables ride every sampling program as fixed-shape
+        # args; state 0 (accept-all) keeps warmup numerics inert.
+        g_allow, g_trans = self._grammar_tables()
+        zgb = np.zeros((b,), np.int32)
         _, self.cache = self._admit_single(
             self.engine.params, jnp.asarray(zeros1), jnp.asarray(zlen1),
             self.cache, jnp.int32(0), jnp.asarray(zseed1),
             jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
-            jnp.asarray(zi1),
+            jnp.asarray(zi1), jnp.asarray(zi1), g_allow, g_trans,
         )
         _, self.cache = self._admit_full(
             self.engine.params, jnp.asarray(np.zeros((b, s), np.int32)),
@@ -1088,14 +1213,16 @@ class ContinuousBatcher:
             jnp.asarray(np.zeros((b,), np.int32)),
             jnp.asarray(np.ones((b,), np.float32)),
             jnp.asarray(np.zeros((b,), np.int32)),
+            jnp.asarray(zgb), g_allow, g_trans,
         )
-        _, self.cache = self._tick(
+        _, self.cache, _ = self._tick(
             self.engine.params, jnp.asarray(self.cur_tokens), self.cache,
             jnp.asarray(self.seeds), jnp.int32(0),
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
             jnp.asarray(self.top_ps),
             jnp.asarray(np.zeros((b,), bool)),
             jnp.asarray(np.zeros((b,), np.int32)),
+            jnp.asarray(self.gstates), g_allow, g_trans,
         )
         # Fused chunked-admission programs. The long-prompt grid
         # ([B, T, C]) compiles per distinct T — warm the single-chunk
@@ -1138,6 +1265,7 @@ class ContinuousBatcher:
                     jnp.asarray(zib[:r_bucket]),
                     jnp.asarray(ofb[:r_bucket]),
                     jnp.asarray(zib[:r_bucket]),
+                    jnp.asarray(zib[:r_bucket]), g_allow, g_trans,
                 )
         if self._ilv_k and (
             self.cfg.prefill_chunk < self._fit_limit or self._ring
@@ -1151,7 +1279,7 @@ class ContinuousBatcher:
             if self._ilv_mini is None:
                 self._ilv_mini = self._make_mini(self._ilv_k, self.max_seq)
             k_rows = self._ilv_k
-            _, self.cache, self._ilv_mini, sel = self._tick_chunk(
+            _, self.cache, self._ilv_mini, sel, _ = self._tick_chunk(
                 self.engine.params, jnp.asarray(self.cur_tokens),
                 self.cache, jnp.asarray(self.seeds), jnp.int32(0),
                 jnp.asarray(self.temps), jnp.asarray(self.top_ks),
@@ -1164,11 +1292,13 @@ class ContinuousBatcher:
                 jnp.asarray(np.ones((k_rows,), np.int32)),
                 jnp.asarray(np.zeros((k_rows,), bool)),
                 jnp.asarray(np.zeros((k_rows,), np.int32)),
+                jnp.asarray(self.gstates), g_allow, g_trans,
             )
             _, self.cache = self._ilv_finish(
                 self.cache, self._ilv_mini, jnp.int32(0), jnp.int32(0),
                 jnp.int32(0), sel, jnp.asarray(zseed1),
                 jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
+                jnp.asarray(zi1), g_allow, g_trans,
             )
         if self._pfx_pool is not None:
             # plen=0 and no host-side key: the warmup entry can never
@@ -1207,6 +1337,7 @@ class ContinuousBatcher:
                         jnp.asarray(ofb[:r_rows]),
                         jnp.asarray(zib[:r_rows]),
                         self._pfx_pool, jnp.int32(0), jnp.int32(0),
+                        jnp.asarray(zib[:r_rows]), g_allow, g_trans,
                     )
                 width *= 2
             # The SERIAL fallback (_prefill_chunked) still serves
@@ -1243,6 +1374,7 @@ class ContinuousBatcher:
                 _ = self._first_token(
                     logits, jnp.asarray(zi1), jnp.asarray(zseed1),
                     jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
+                    jnp.asarray(zi1), g_allow, g_trans,
                 )
         jax.block_until_ready(self.cache.k)
 
@@ -1272,6 +1404,7 @@ class ContinuousBatcher:
         unary: bool = False,
         adapter: int = 0,
         trace_id: str = "",
+        grammar: Optional[CompiledGrammar] = None,
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         """Enqueue a request; yields (token_ids_chunk, finish_reason)
         pairs; finish_reason is set on the final chunk. `unary=True`
@@ -1281,7 +1414,12 @@ class ContinuousBatcher:
         resolve names via engine.resolve_adapter). `trace_id`: the
         gateway trace this request serves — stamped into the flight
         recorder's request/tick records so one id walks span → request
-        record → tick records.
+        record → tick records. `grammar`: a CompiledGrammar
+        (ggrmcp_tpu/grammar) every sampled token must satisfy — decode
+        is DFA-masked on device, finish_reason "grammar_complete" fires
+        when the accepting sink is reached, and GrammarCapacityError is
+        raised here, eagerly, when the table arena cannot host another
+        distinct schema.
 
         Validation, the admission-cap check, and the enqueue all run
         HERE, eagerly, not at first iteration of the returned
@@ -1329,10 +1467,14 @@ class ContinuousBatcher:
                 f"admission queue token budget full ({tcap} tokens)",
                 reason="tokens",
             )
+        # Arena residency is taken HERE (host-side bookkeeping only —
+        # the device upload happens lazily in the executor), after the
+        # overload caps: a shed request must not hold table rows.
+        handle = self.arena.acquire(grammar) if grammar is not None else None
         request = _Request(
             prompt=prompt, max_new=max_new, sampling=sampling, seed=seed,
             unary=unary, adapter=adapter, trace_id=trace_id,
-            n_prompt=len(prompt),
+            n_prompt=len(prompt), grammar=handle,
         )
         request.t_submit = time.perf_counter()
         self.pending.put_nowait(request)
@@ -1459,6 +1601,12 @@ class ContinuousBatcher:
             # piggybacked onto decode ticks / requests admitted that way.
             "interleaved_chunks": self.interleaved_chunks,
             "interleaved_admissions": self.interleaved_admissions,
+            # Grammar-constrained decoding: tokens emitted under an
+            # active DFA mask, and arena table rows currently resident
+            # (state 0 + every cached grammar's states). The sidecar
+            # adds the compile/cache-hit counters from its GrammarCache.
+            "grammar_masked_tokens": self.grammar_tokens,
+            "grammar_states_in_use": self.arena.states_in_use(),
             # Per-tick timing breakdown (cumulative ms + counts):
             # dispatch = host-side tick launch, collect = blocking
             # token pull (device wait + transfer), admit = full
@@ -1540,7 +1688,10 @@ class ContinuousBatcher:
         """Flight-record a request's terminal outcome — called on EVERY
         path that queues a terminal chunk (emission finish, queue
         timeout, replay exhaustion, cancellation, admission failure),
-        so the request ring accounts for failures, not only successes."""
+        so the request ring accounts for failures, not only successes.
+        Doubles as the one place a terminal request returns its grammar
+        arena reference (same every-path property)."""
+        self._grammar_release(request)
         if not self.recorder.enabled:
             return
         if request.first_tick >= 0:
@@ -1551,6 +1702,7 @@ class ContinuousBatcher:
             request.trace_id, request.t_submit, request.t_admit,
             request.t_first, request.n_prompt, len(request.acc),
             reason, request.first_tick, last_tick,
+            constrained=request.grammar is not None,
         )
 
     def _replay_or_fail(self, request: _Request) -> None:
@@ -1625,10 +1777,14 @@ class ContinuousBatcher:
         # The tick donated the shared cache, so its buffers are dead
         # after an error — rebuild, or every future admission scatter
         # would fail and no request could ever succeed. The in-flight
-        # queue and device token feedback are poisoned with it.
+        # queue and device token feedback are poisoned with it. Grammar
+        # state resets with the slots: every victim re-derives its DFA
+        # state from its replay prefix at re-admission (_g0).
         self._inflight.clear()
         self._cur_dev = None
         self.adapter_ids[:] = 0
+        self.gstates[:] = 0
+        self._gstate_dev = None
         self.cache = self.engine.make_cache(
             len(self.slots), self.max_seq
         )
@@ -1915,6 +2071,7 @@ class ContinuousBatcher:
         ks = np.zeros((r,), np.int32)
         ps = np.ones((r,), np.float32)
         adapters = np.zeros((r,), np.int32)
+        g0s = np.zeros((r,), np.int32)
         for j, (sl, req) in enumerate(rows):
             piece = np.asarray(req.prompt[start:], np.int32)
             tokens[j].reshape(-1)[: len(piece)] = piece
@@ -1925,8 +2082,10 @@ class ContinuousBatcher:
             ks[j] = req.sampling.top_k
             ps[j] = req.sampling.top_p
             adapters[j] = req.adapter
+            g0s[j] = self._g0(req)
         if pfx is not None:
             self.prefix_hits += len(rows)
+        g_allow, g_trans = self._grammar_tables()
         self._cache_at_risk = True
         if pfx is None:
             first, self.cache = self._admit_chunked(
@@ -1934,6 +2093,7 @@ class ContinuousBatcher:
                 jnp.asarray(true_len), self.cache, jnp.asarray(slots_arr),
                 jnp.asarray(seeds), jnp.asarray(temps), jnp.asarray(ks),
                 jnp.asarray(ps), jnp.asarray(adapters),
+                jnp.asarray(g0s), g_allow, g_trans,
             )
         else:
             first, self.cache = self._admit_chunked_pfx(
@@ -1942,6 +2102,7 @@ class ContinuousBatcher:
                 jnp.asarray(seeds), jnp.asarray(temps), jnp.asarray(ks),
                 jnp.asarray(ps), jnp.asarray(adapters),
                 self._pfx_pool, jnp.int32(entry), jnp.int32(start),
+                jnp.asarray(g0s), g_allow, g_trans,
             )
         # Materialize BEFORE clearing the at-risk flag (async-dispatch
         # failure surfacing — same contract as _prefill_fused).
@@ -1979,6 +2140,7 @@ class ContinuousBatcher:
         ps = np.ones((rows,), np.float32)
         valid = np.zeros((rows,), bool)
         adapters = np.zeros((rows,), np.int32)
+        g0s = np.zeros((rows,), np.int32)
         for j, req in enumerate(batch):
             row = row_of(j)
             tokens[row, : len(req.prompt)] = req.prompt
@@ -1989,6 +2151,8 @@ class ContinuousBatcher:
             ps[row] = req.sampling.top_p
             valid[row] = True
             adapters[row] = req.adapter
+            g0s[row] = self._g0(req)
+        g_allow, g_trans = self._grammar_tables()
         self._cache_at_risk = True
         if single:
             first, self.cache = self._admit_single(
@@ -1997,6 +2161,7 @@ class ContinuousBatcher:
                 jnp.int32(slots_idx[0]), jnp.asarray(seeds),
                 jnp.asarray(temps), jnp.asarray(ks), jnp.asarray(ps),
                 jnp.asarray(adapters),
+                jnp.asarray(g0s), g_allow, g_trans,
             )
         else:
             first, self.cache = self._admit_full(
@@ -2004,6 +2169,7 @@ class ContinuousBatcher:
                 jnp.asarray(true_len), self.cache, jnp.asarray(valid),
                 jnp.asarray(seeds), jnp.asarray(temps), jnp.asarray(ks),
                 jnp.asarray(ps), jnp.asarray(adapters),
+                jnp.asarray(g0s), g_allow, g_trans,
             )
         # Materialize BEFORE clearing the at-risk flag: under async
         # dispatch a device failure in the donating call surfaces here,
@@ -2063,15 +2229,22 @@ class ContinuousBatcher:
         rec = self._tick_record(active)
         if self._cur_dev is None:
             self._cur_dev = jnp.asarray(self.cur_tokens)
-        toks, self.cache = self._tick(
+        if self._gstate_dev is None:
+            self._gstate_dev = jnp.asarray(self.gstates)
+        g_allow, g_trans = self._grammar_tables()
+        toks, self.cache, gstate_out = self._tick(
             self.engine.params, self._cur_dev, self.cache,
             jnp.asarray(self.seeds), jnp.int32(step0 + 1),
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
             jnp.asarray(self.top_ps), jnp.asarray(active),
             jnp.asarray(self.adapter_ids),
+            self._gstate_dev, g_allow, g_trans,
         )
-        # Device-side feedback for the next tick; no host sync.
+        # Device-side feedback for the next tick; no host sync. Grammar
+        # state rides the same way: the scan's final per-row states
+        # feed the next dispatch without materializing.
         self._cur_dev = toks[:, -1]
+        self._gstate_dev = gstate_out
         try:
             toks.copy_to_host_async()
         except (AttributeError, RuntimeError):
@@ -2124,7 +2297,10 @@ class ContinuousBatcher:
             c_valid[r] = True
             c_adapt[r] = st.request.adapter
         rec = self._tick_record(active, ilv_rows=int(c_valid.sum()))
-        toks, self.cache, self._ilv_mini, sel = self._tick_chunk(
+        if self._gstate_dev is None:
+            self._gstate_dev = jnp.asarray(self.gstates)
+        g_allow, g_trans = self._grammar_tables()
+        toks, self.cache, self._ilv_mini, sel, gstate_out = self._tick_chunk(
             self.engine.params, self._cur_dev, self.cache,
             jnp.asarray(self.seeds), jnp.int32(step0 + 1),
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
@@ -2132,8 +2308,10 @@ class ContinuousBatcher:
             jnp.asarray(self.adapter_ids),
             jnp.asarray(chunk), self._ilv_mini, jnp.asarray(offs),
             jnp.asarray(c_tl), jnp.asarray(c_valid), jnp.asarray(c_adapt),
+            self._gstate_dev, g_allow, g_trans,
         )
         self._cur_dev = toks[:, -1]
+        self._gstate_dev = gstate_out
         try:
             toks.copy_to_host_async()
         except (AttributeError, RuntimeError):
@@ -2161,6 +2339,7 @@ class ContinuousBatcher:
         _recover_after_tick_failure owns the cleanup."""
         st = self._ilv_rows[r]
         req = st.request
+        g_allow, g_trans = self._grammar_tables()
         first, self.cache = self._ilv_finish(
             self.cache, self._ilv_mini, jnp.int32(r), jnp.int32(st.slot),
             jnp.int32(st.n), sel,
@@ -2168,6 +2347,7 @@ class ContinuousBatcher:
             jnp.asarray([req.sampling.temperature], np.float32),
             jnp.asarray([req.sampling.top_k], np.int32),
             jnp.asarray([req.sampling.top_p], np.float32),
+            jnp.asarray([self._g0(req)], np.int32), g_allow, g_trans,
         )
         first_tok = int(np.asarray(first)[0])
         self._ilv_rows[r] = None
@@ -2208,10 +2388,22 @@ class ContinuousBatcher:
         for token in tokens:
             token = int(token)
             if token == self.eos_id:
+                # Under a grammar, EOS is only sampleable in accepting
+                # DFA states — the output is complete valid JSON.
                 finished_reason = "stop"
                 break
             ids.append(token)
             slot.generated += 1
+            if request.grammar is not None:
+                # Advance the host DFA tracker through the emitted
+                # token; reaching the accepting SINK (nothing may
+                # follow) finishes the request — the schema's terminal
+                # brace, not EOS, ends a constrained generation.
+                request.gcur = self.arena.step(request.gcur, token)
+                self.grammar_tokens += 1
+                if self.arena.is_sink(request.gcur):
+                    finished_reason = "grammar_complete"
+                    break
             if slot.generated >= slot.max_new:
                 finished_reason = "length"
                 break
@@ -2245,9 +2437,13 @@ class ContinuousBatcher:
                 (time.perf_counter() - request.t_admit) * 1000.0,
             ))
             # Freeze the row so it stops influencing shared state
-            # (cache row stays, masked by length on reuse).
+            # (cache row stays, masked by length on reuse). The host
+            # grammar-state mirror resets too; the device twin keeps
+            # its stale value until the slot is re-admitted (the parked
+            # row's junk tokens are dropped here regardless).
             self.temps[slot_idx] = 0.0
             self.adapter_ids[slot_idx] = 0
+            self.gstates[slot_idx] = 0
         # Every delivered token also lands in `acc`: for unary
         # consumers it is the terminal payload; for ALL consumers it
         # is the replay prefix a tick failure resumes from.

@@ -94,6 +94,10 @@ class RequestRecord:
     first_tick: int
     last_tick: int
     source: str = ""
+    # Grammar-constrained decode (ggrmcp_tpu/grammar): this request's
+    # tokens were DFA-masked — "why is this request's output shaped
+    # like that" answered from the ring.
+    constrained: bool = False
 
     def to_dict(self) -> dict:
         return {
@@ -109,6 +113,7 @@ class RequestRecord:
             "firstTick": self.first_tick,
             "lastTick": self.last_tick,
             "source": self.source,
+            "constrained": self.constrained,
         }
 
 
@@ -215,6 +220,7 @@ class FlightRecorder:
         finish_reason: str,
         first_tick: int,
         last_tick: int,
+        constrained: bool = False,
     ) -> None:
         """Record a request's terminal chunk; derives ttft/queue/e2e
         and feeds the histograms. Stamps that never happened (a timeout
@@ -244,6 +250,7 @@ class FlightRecorder:
             first_tick=first_tick,
             last_tick=last_tick,
             source=self.source,
+            constrained=constrained,
         )
         self._requests.append(rec)
         with self._lock:

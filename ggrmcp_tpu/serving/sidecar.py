@@ -23,6 +23,12 @@ import grpc.aio
 import numpy as np
 
 from ggrmcp_tpu.core.config import Config, ServingConfig
+from ggrmcp_tpu.grammar import (
+    CompiledGrammar,
+    GrammarCache,
+    GrammarCapacityError,
+    GrammarError,
+)
 from ggrmcp_tpu.models import get_model
 from ggrmcp_tpu.ops.sampling import SamplingConfig
 from ggrmcp_tpu.rpc.pb import serving_pb2
@@ -35,7 +41,7 @@ from ggrmcp_tpu.rpc.server_utils import (
 from ggrmcp_tpu.serving import tensors
 from ggrmcp_tpu.serving.batching import ContinuousBatcher, OverloadedError
 from ggrmcp_tpu.serving.engine import EmbeddingEngine, GenerationEngine
-from ggrmcp_tpu.serving.tokenizer import load_tokenizer
+from ggrmcp_tpu.serving.tokenizer import ByteTokenizer, load_tokenizer
 from ggrmcp_tpu.utils import tracing
 
 logger = logging.getLogger("ggrmcp.serving.sidecar")
@@ -102,6 +108,13 @@ class Sidecar:
         self.port = 0
         self.target = ""  # dialable target string, set by start()
         self._profile_lock = asyncio.Lock()
+        # Schema-constrained decoding (ggrmcp_tpu/grammar): LRU of
+        # compiled DFAs keyed by canonical schema hash — a tool whose
+        # output schema rides every call compiles once (the compiles/
+        # hits counters export through ServingStats).
+        self.grammar_cache = GrammarCache(
+            self.serving.grammar.cache_entries
+        )
 
     # ------------------------------------------------------------------
     # EmbedService
@@ -184,6 +197,50 @@ class Sidecar:
         except ValueError as exc:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
 
+    async def _resolve_grammar(
+        self, request: serving_pb2.GenerateRequest, context
+    ) -> Optional[CompiledGrammar]:
+        """GenerateRequest.constraint → compiled DFA (LRU-cached by
+        schema hash). Bad schemas are the CALLER's error — unsupported
+        dialect, over-budget DFAs, and unresolved tool refs all abort
+        INVALID_ARGUMENT; nothing here can 500."""
+        spec = request.constraint
+        if not (spec.json_schema or spec.tool_output_schema_ref):
+            return None
+        if not self.serving.grammar.enabled:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "constrained decoding is disabled (serving.grammar.enabled)",
+            )
+        if not spec.json_schema:
+            # The sidecar has no tool registry; the gateway resolves
+            # tool_output_schema_ref into an inline schema before the
+            # backend call (gateway.structured_output).
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "constraint.tool_output_schema_ref must be resolved to "
+                "an inline json_schema by the gateway",
+            )
+        if not isinstance(self.tokenizer, ByteTokenizer):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "constrained decoding requires the byte-level tokenizer "
+                "(subword DFA alignment is not implemented)",
+            )
+        try:
+            return self.grammar_cache.get(
+                spec.json_schema,
+                vocab_size=self.generation.cfg.vocab_size,
+                eos_id=self.tokenizer.eos_id,
+                max_states=self.serving.grammar.max_states,
+                byte_offset=ByteTokenizer.OFFSET,
+            )
+        except GrammarError as exc:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"constraint schema rejected: {exc}",
+            )
+
     async def generate(self, request: serving_pb2.GenerateRequest, context):
         assert self.generation is not None and self.batcher is not None
         t0 = time.perf_counter()
@@ -198,6 +255,7 @@ class Sidecar:
         finish = "length"
         sampling = self._sampling(request)
         adapter = await self._resolve_adapter(request, context)
+        grammar = await self._resolve_grammar(request, context)
         # Draft-assisted path: greedy requests (lossless, bitwise) and
         # plain temperature sampling (rejection sampling — lossless in
         # distribution, ops/speculative.py). top-k/top-p filtering is
@@ -210,11 +268,15 @@ class Sidecar:
         # reach this gate: lora + speculative_draft is rejected at
         # engine init (engine._init_lora), so a draft-configured
         # sidecar resolves every request to the base model.
+        # Constrained rows reject into the normal path: the speculative
+        # micro-batch has no grammar mask, and a drafted token the DFA
+        # forbids would break the conformance guarantee.
         speculative = (
             self.generation.draft_fam is not None
             and sampling.top_k <= 0
             and sampling.top_p >= 1.0
             and len(prompt) <= self.serving.batching.prefill_chunk
+            and grammar is None
         )
         with tracing.tracer.span(
             "sidecar.generate",
@@ -243,7 +305,7 @@ class Sidecar:
                 try:
                     it = self.batcher.submit(
                         prompt, max_new, sampling, seed, unary=True,
-                        adapter=adapter, trace_id=trace_id,
+                        adapter=adapter, trace_id=trace_id, grammar=grammar,
                     )
                 except OverloadedError as exc:
                     # Load shedding, not failure: RESOURCE_EXHAUSTED is
@@ -252,6 +314,12 @@ class Sidecar:
                     await context.abort(
                         grpc.StatusCode.RESOURCE_EXHAUSTED,
                         f"server overloaded ({exc.reason}): {exc}",
+                    )
+                except GrammarCapacityError as exc:
+                    # Too many DISTINCT schemas decoding at once —
+                    # transient, retryable: same overload contract.
+                    await context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc)
                     )
                 async for chunk_ids, reason in it:
                     token_ids.extend(chunk_ids)
@@ -286,18 +354,36 @@ class Sidecar:
         )
         seed = request.sampling.seed or 0
         adapter = await self._resolve_adapter(request, context)
+        grammar = await self._resolve_grammar(request, context)
         emitted = ""
         stops = list(request.stop)
         all_ids: list[int] = []
+        # Incremental UTF-8 decode (serving/tokenizer.py): the decoder
+        # buffers an incomplete trailing multi-byte sequence across
+        # chunk boundaries, so text_delta never carries U+FFFD for text
+        # that is merely split mid-rune. Tokenizers without one (HF
+        # subword) keep the decode-everything + stable-prefix fallback.
+        mk_decoder = getattr(self.tokenizer, "stream_decoder", None)
+        decoder = mk_decoder() if mk_decoder is not None else None
+        decoded = {"text": ""}
 
         def delta_for(final: bool) -> tuple[str, str]:
             """(delta, stop_hit): emit only the stable prefix while
             streaming (incomplete multi-byte UTF-8 is held back until
             the sequence completes); flush everything on the final
             chunk."""
-            text = self.tokenizer.decode(all_ids)
-            stopped_text, stop_hit = _apply_stops(text, stops, "")
-            stable = stopped_text if final else _stable_prefix(stopped_text)
+            if decoder is not None:
+                text = decoded["text"]
+                if final:
+                    text = decoded["text"] = text + decoder.flush()
+                stopped_text, stop_hit = _apply_stops(text, stops, "")
+                stable = stopped_text  # complete sequences only, by feed()
+            else:
+                text = self.tokenizer.decode(all_ids)
+                stopped_text, stop_hit = _apply_stops(text, stops, "")
+                stable = (
+                    stopped_text if final else _stable_prefix(stopped_text)
+                )
             if len(stable) < len(emitted):
                 return "", stop_hit  # stop cut before emitted point
             return stable[len(emitted):], stop_hit
@@ -305,7 +391,7 @@ class Sidecar:
         try:
             it = self.batcher.submit(
                 prompt, max_new, self._sampling(request), seed,
-                adapter=adapter, trace_id=trace_id,
+                adapter=adapter, trace_id=trace_id, grammar=grammar,
             )
         except OverloadedError as exc:
             # Shed before any chunk is written — same overload contract
@@ -314,8 +400,14 @@ class Sidecar:
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 f"server overloaded ({exc.reason}): {exc}",
             )
+        except GrammarCapacityError as exc:
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc)
+            )
         async for chunk_ids, reason in it:
             all_ids.extend(chunk_ids)
+            if decoder is not None:
+                decoded["text"] += decoder.feed(chunk_ids)
             final = reason is not None
             delta, stop_hit = delta_for(final)
             if delta:
@@ -368,6 +460,11 @@ class Sidecar:
         zeros for an embed-only sidecar (no batcher). The kwargs
         construction fails loudly if stats() keys drift from the proto."""
         stats = dict(self.batcher.stats()) if self.batcher is not None else {}
+        if self.batcher is not None:
+            # Sidecar-owned grammar compile cache (the batcher/tiers
+            # contribute grammar_masked_tokens / grammar_states_in_use).
+            stats["grammar_compiles"] = self.grammar_cache.compiles
+            stats["grammar_cache_hits"] = self.grammar_cache.hits
         if self.spec_batcher is not None:
             stats["speculative_calls"] = self.spec_batcher.calls
             stats["speculative_requests"] = self.spec_batcher.requests
@@ -500,7 +597,7 @@ class Sidecar:
                     prompt_tokens=r.prompt_tokens, tokens=r.tokens,
                     finish_reason=r.finish_reason, decode_tps=r.decode_tps,
                     first_tick=r.first_tick, last_tick=r.last_tick,
-                    source=r.source,
+                    source=r.source, constrained=r.constrained,
                 )
                 for r in requests
             ],

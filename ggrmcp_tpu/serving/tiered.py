@@ -116,6 +116,7 @@ class TieredBatcher:
         unary: bool = False,
         adapter: int = 0,
         trace_id: str = "",
+        grammar=None,
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         last_exc: Optional[OverloadedError] = None
         probed: list[ContinuousBatcher] = []
@@ -123,7 +124,7 @@ class TieredBatcher:
             try:
                 it = tier.submit(
                     prompt, max_new, sampling, seed, unary=unary,
-                    adapter=adapter, trace_id=trace_id,
+                    adapter=adapter, trace_id=trace_id, grammar=grammar,
                 )
             except OverloadedError as exc:
                 last_exc = exc

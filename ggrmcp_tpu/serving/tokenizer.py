@@ -8,6 +8,7 @@ egress. When a HuggingFace `tokenizer.json` is available on disk, the
 
 from __future__ import annotations
 
+import codecs
 import os
 from typing import Protocol
 
@@ -20,6 +21,36 @@ class Tokenizer(Protocol):
 
     def encode(self, text: str) -> list[int]: ...
     def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteStreamDecoder:
+    """Incremental UTF-8 decode for ByteTokenizer id streams.
+
+    A streaming chunk boundary can split a multi-byte UTF-8 sequence;
+    decoding each chunk independently would emit U+FFFD for the
+    dangling lead bytes and corrupt the stream irreversibly. This
+    buffers an incomplete trailing sequence (codecs' incremental
+    decoder) until the bytes that finish it arrive; only `flush()` —
+    the end of the stream — turns a genuinely dangling tail into
+    replacement characters."""
+
+    def __init__(self, offset: int = 3) -> None:
+        self._offset = offset
+        self._decoder = codecs.getincrementaldecoder("utf-8")("replace")
+
+    def feed(self, ids: list[int]) -> str:
+        """Decode a chunk of token ids; returns only the text that is
+        COMPLETE so far (incomplete trailing bytes stay buffered)."""
+        data = bytes(
+            i - self._offset for i in ids
+            if i >= self._offset and i - self._offset < 256
+        )
+        return self._decoder.decode(data, False)
+
+    def flush(self) -> str:
+        """End of stream: drain the buffer (an incomplete tail decodes
+        with replacement characters — the model truly stopped mid-rune)."""
+        return self._decoder.decode(b"", True)
 
 
 class ByteTokenizer:
@@ -41,6 +72,11 @@ class ByteTokenizer:
             i - self.OFFSET for i in ids if i >= self.OFFSET and i - self.OFFSET < 256
         )
         return data.decode("utf-8", errors="replace")
+
+    def stream_decoder(self) -> ByteStreamDecoder:
+        """Per-stream incremental decoder (GenerateStream text_delta
+        safety: never emit a split multi-byte sequence as U+FFFD)."""
+        return ByteStreamDecoder(self.OFFSET)
 
 
 class HFTokenizer:

@@ -1,0 +1,168 @@
+"""Static↔dynamic sampling parity (ISSUE 4 satellite).
+
+`sample` (static jit-arg config) and `sample_dynamic` (traced per-row
+config — the continuous-batching path) implement the same sampling
+policy with different machinery: explicit masking vs one sorted-
+threshold pass. The property held here: for equal configs the two
+paths keep IDENTICAL token sets — the support of the sampling
+distribution — across every temperature / top-k / top-p combination,
+including the boundary cases (k and p both active, where top-p must be
+computed over the top-k-renormalized distribution, and temperature,
+which scales BEFORE the nucleus test). The grammar mask
+(masked_sample_dynamic) composes with exactly these semantics, so this
+net also guards constrained sampling's boundary behavior.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.ops.sampling import (
+    SamplingConfig,
+    _mask_top_k,
+    _mask_top_p,
+    dynamic_support_mask,
+    masked_sample_dynamic,
+    sample,
+    sample_dynamic,
+)
+
+pytestmark = pytest.mark.grammar
+
+
+def _static_support(logits: jnp.ndarray, cfg: SamplingConfig) -> np.ndarray:
+    """The token set sample() can draw: replicate its exact masking
+    pipeline (temperature scale → top-k → top-p) and read the finite
+    entries."""
+    masked = logits.astype(jnp.float32) / max(cfg.temperature, 1e-9)
+    if cfg.top_k > 0:
+        masked = _mask_top_k(masked, cfg.top_k)
+    if cfg.top_p < 1.0:
+        masked = _mask_top_p(masked, cfg.top_p)
+    return np.asarray(jnp.isfinite(masked))
+
+
+class TestStaticDynamicParity:
+    @pytest.mark.parametrize("temperature", [0.5, 1.0, 2.3])
+    @pytest.mark.parametrize("top_k", [0, 1, 3, 64])
+    @pytest.mark.parametrize("top_p", [0.3, 0.6, 0.95, 1.0])
+    def test_support_sets_identical(self, temperature, top_k, top_p):
+        """THE parity property: equal configs → equal sampleable token
+        sets, for every (t, k, p) combination."""
+        logits = jax.random.normal(jax.random.PRNGKey(42), (6, 64)) * 3.0
+        cfg = SamplingConfig(
+            temperature=temperature, top_k=top_k, top_p=top_p
+        )
+        static = _static_support(logits, cfg)
+        b = logits.shape[0]
+        dynamic = np.asarray(dynamic_support_mask(
+            logits,
+            jnp.full((b,), temperature, jnp.float32),
+            jnp.full((b,), top_k, jnp.int32),
+            jnp.full((b,), top_p, jnp.float32),
+        ))
+        np.testing.assert_array_equal(
+            static, dynamic,
+            err_msg=f"support mismatch at t={temperature} k={top_k} "
+                    f"p={top_p}",
+        )
+
+    def test_combined_k_and_p_renormalizes_within_top_k(self):
+        """The boundary case the property exists for: with both active,
+        top-p must act on the top-k-RENORMALIZED distribution (static
+        path order). probs [0.5, 0.3, 0.2], k=2, p=0.6: renormalized
+        top-2 is [0.625, 0.375], mass before token 1 is 0.625 > 0.6 →
+        only token 0 survives. (Computed over the FULL distribution the
+        mass before token 1 is 0.5 < 0.6 and token 1 would leak in.)"""
+        probs = np.array([[0.5, 0.3, 0.2]])
+        logits = jnp.asarray(np.log(probs))
+        support = np.asarray(dynamic_support_mask(
+            logits, jnp.ones((1,)), jnp.array([2], jnp.int32),
+            jnp.array([0.6], jnp.float32),
+        ))
+        assert support.tolist() == [[True, False, False]]
+        assert _static_support(
+            logits, SamplingConfig(temperature=1.0, top_k=2, top_p=0.6)
+        ).tolist() == [[True, False, False]]
+
+    def test_sampled_tokens_land_in_static_support(self):
+        """End-to-end: every token sample_dynamic actually draws lies
+        in the static path's support."""
+        logits = jax.random.normal(jax.random.PRNGKey(7), (4, 32)) * 2.0
+        cfg = SamplingConfig(temperature=0.8, top_k=5, top_p=0.7)
+        static = _static_support(logits, cfg)
+        b = logits.shape[0]
+        for step in range(24):
+            toks = np.asarray(sample_dynamic(
+                logits, jnp.arange(b, dtype=jnp.uint32), jnp.int32(step),
+                jnp.full((b,), cfg.temperature),
+                jnp.full((b,), cfg.top_k, jnp.int32),
+                jnp.full((b,), cfg.top_p),
+            ))
+            for row, tok in enumerate(toks):
+                assert static[row, tok], (step, row, int(tok))
+
+    def test_greedy_matches_static(self):
+        logits = jax.random.normal(jax.random.PRNGKey(3), (4, 100))
+        static = sample(logits, jax.random.PRNGKey(0), SamplingConfig())
+        dynamic = sample_dynamic(
+            logits, jnp.zeros(4, jnp.uint32), jnp.int32(0),
+            jnp.zeros(4), jnp.zeros(4, jnp.int32), jnp.ones(4),
+        )
+        assert static.tolist() == dynamic.tolist()
+
+
+class TestMaskedSampling:
+    def _tables(self, v=16):
+        # Two states: state 0 accept-all, state 1 allows only tokens
+        # {3, 5} (3 → state 1 self-ish advance to 0, 5 → stays 1).
+        allow = np.zeros((2, v), bool)
+        allow[0, :] = True
+        allow[1, [3, 5]] = True
+        trans = np.tile(np.arange(2, dtype=np.int32)[:, None], (1, v))
+        trans[1, 3] = 0
+        return jnp.asarray(allow), jnp.asarray(trans)
+
+    def test_state0_is_numerically_transparent(self):
+        """Unconstrained rows (state 0) must produce BIT-identical
+        tokens to plain sample_dynamic — the mixed-batch contract."""
+        allow, trans = self._tables()
+        logits = jax.random.normal(jax.random.PRNGKey(5), (3, 16))
+        seeds = jnp.arange(3, dtype=jnp.uint32)
+        args = (seeds, jnp.int32(4), jnp.full((3,), 0.9),
+                jnp.zeros(3, jnp.int32), jnp.full((3,), 0.8))
+        plain = sample_dynamic(logits, *args)
+        masked, nxt = masked_sample_dynamic(
+            logits, *args, jnp.zeros(3, jnp.int32), allow, trans
+        )
+        assert plain.tolist() == masked.tolist()
+        assert nxt.tolist() == [0, 0, 0]
+
+    def test_constrained_rows_only_draw_allowed_tokens(self):
+        allow, trans = self._tables()
+        logits = jax.random.normal(jax.random.PRNGKey(6), (2, 16)) * 4
+        for step in range(16):
+            toks, nxt = masked_sample_dynamic(
+                logits, jnp.arange(2, dtype=jnp.uint32), jnp.int32(step),
+                jnp.full((2,), 1.0), jnp.zeros(2, jnp.int32),
+                jnp.ones((2,)),
+                jnp.array([1, 1], jnp.int32), allow, trans,
+            )
+            for tok, s in zip(toks.tolist(), nxt.tolist()):
+                assert tok in (3, 5)
+                assert s == (0 if tok == 3 else 1)
+
+    def test_greedy_respects_mask(self):
+        """Greedy (temperature 0) must argmax over the ALLOWED set even
+        when the global argmax is disallowed."""
+        allow, trans = self._tables()
+        logits = np.full((1, 16), -1.0, np.float32)
+        logits[0, 7] = 10.0   # global argmax, disallowed in state 1
+        logits[0, 5] = 1.0
+        toks, _ = masked_sample_dynamic(
+            jnp.asarray(logits), jnp.zeros(1, jnp.uint32), jnp.int32(0),
+            jnp.zeros((1,)), jnp.zeros(1, jnp.int32), jnp.ones((1,)),
+            jnp.array([1], jnp.int32), allow, trans,
+        )
+        assert toks.tolist() == [5]

@@ -1,11 +1,13 @@
 """Tokenizer plane (`serving/tokenizer.py`): the hermetic byte-level
-default and the HuggingFace-file path (built in-test — no downloaded
-assets in this zero-egress environment).
+default, the streaming-safe incremental UTF-8 decoder, and the
+HuggingFace-file path (built in-test — no downloaded assets in this
+zero-egress environment).
 """
 
 import pytest
 
 from ggrmcp_tpu.serving.tokenizer import (
+    ByteStreamDecoder,
     ByteTokenizer,
     HFTokenizer,
     load_tokenizer,
@@ -32,6 +34,57 @@ class TestByteTokenizer:
         everything = bytes(range(256)).decode("latin-1")
         encoded = tok.encode(everything)
         assert max(encoded) < tok.vocab_size + 256  # multi-byte utf-8 ok
+
+
+class TestByteStreamDecoder:
+    """GenerateChunk.text_delta safety: a chunk boundary inside a
+    multi-byte UTF-8 sequence must never surface U+FFFD mid-stream."""
+
+    def _feed_in_chunks(self, text: str, size: int) -> str:
+        tok = ByteTokenizer()
+        ids = tok.encode(text)
+        dec = tok.stream_decoder()
+        out = ""
+        for i in range(0, len(ids), size):
+            piece = dec.feed(ids[i:i + size])
+            assert "�" not in piece, (text, size, i)
+            out += piece
+        return out + dec.flush()
+
+    @pytest.mark.parametrize("size", [1, 2, 3, 5, 7])
+    def test_split_multibyte_reassembles(self, size):
+        for text in ("héllo wörld", "日本語テスト", "mix: é日x🎉y", "🎉🎉"):
+            assert self._feed_in_chunks(text, size) == text
+
+    def test_incomplete_tail_held_until_completed(self):
+        tok = ByteTokenizer()
+        dec = tok.stream_decoder()
+        ids = tok.encode("日")  # 3 bytes
+        assert dec.feed(ids[:1]) == ""
+        assert dec.feed(ids[1:2]) == ""
+        assert dec.feed(ids[2:]) == "日"
+        assert dec.flush() == ""
+
+    def test_flush_replaces_genuinely_dangling_tail(self):
+        tok = ByteTokenizer()
+        dec = tok.stream_decoder()
+        ids = tok.encode("a日")
+        assert dec.feed(ids[:2]) == "a"  # lead byte buffered
+        assert dec.flush() == "�"   # stream truly ended mid-rune
+
+    def test_specials_and_out_of_range_dropped(self):
+        tok = ByteTokenizer()
+        dec = tok.stream_decoder()
+        ids = [tok.bos_id, *tok.encode("ok"), tok.eos_id, 99999]
+        assert dec.feed(ids) + dec.flush() == "ok"
+
+    def test_standalone_decoder_matches_batch_decode(self):
+        tok = ByteTokenizer()
+        text = "stream ✓ parity 日本語"
+        ids = tok.encode(text)
+        dec = ByteStreamDecoder(ByteTokenizer.OFFSET)
+        streamed = "".join(dec.feed([i]) for i in ids) + dec.flush()
+        assert streamed == tok.decode(ids) == text
 
 
 @pytest.fixture(scope="module")
