@@ -6,7 +6,8 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: proto proto-check descriptors test test-all test-fast test-chaos \
-  test-obs test-grammar bench-cpu smoke e2e lint ci-local preflight clean
+  test-obs test-grammar test-spec-batch bench-cpu smoke e2e lint ci-local \
+  preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
 # No protoc on this image? scripts/regen_serving_pb2.py regenerates
@@ -61,6 +62,14 @@ test-obs:
 # too; this target is the fast inner loop for ggrmcp_tpu/grammar work.
 test-grammar:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m grammar
+
+# Speculative continuous batching alone (CPU mesh): greedy bitwise
+# identity spec-on vs spec-off across every admission path, filtered
+# (top-k/top-p) rejection-sampling losslessness, compile-count
+# stability for mixed batches, chaos replay with spec on. Tier-1 runs
+# these too; this target is the fast inner loop for spec-tick work.
+test-spec-batch:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m spec_batch
 
 # CPU smoke of the full bench, including the mixed long-prompt+decode
 # workload phase (interleaved prefill on — A/B the serialized baseline

@@ -362,6 +362,19 @@ class BatchingConfig:
     # requests that exhaust the budget see finish_reason "error". 0 =
     # fail every victim immediately (the pre-replay behavior).
     tick_retry_limit: int = 1
+    # Speculative decoding INSIDE the continuous batcher
+    # (docs/speculative.md): "on" + a configured serving.speculative_
+    # draft makes every decode tick one fixed-shape draft/verify round
+    # — gamma draft steps against a per-slot draft KV cache, then ONE
+    # (gamma+1)-position target verify over the shared slot pool, with
+    # variable advance expressed as per-slot length-pointer arithmetic
+    # (never dynamic shapes). Greedy rows stay bitwise identical to
+    # spec-off; sampled rows (incl. top-k/top-p) are rejection-sampled
+    # losslessly over the filtered distributions; grammar-constrained
+    # rows verify against the DFA mask. "off" (default) keeps the
+    # plain tick; the side SpeculativeBatcher micro-path then serves
+    # draft-eligible unary calls as before.
+    speculative: str = "off"  # off | on
 
 
 # decode_steps_per_tick="auto" resolves to this on TPU meshes: with
@@ -533,13 +546,15 @@ class ServingConfig:
     # with kv_tiers and the prefix pool; composes with int8 KV and
     # pipeline serving (validate() below, tests/test_pp_serving.py).
     kv_ring: bool = False
-    # Speculative decoding (greedy/lossless): registry key of a small
-    # dense draft model sharing the target's vocab ("" → off). Unary
-    # greedy Generate calls then verify `speculative_gamma` drafted
-    # tokens per target forward (ops/speculative.py). Tradeoff: these
-    # calls bypass the continuous batcher (each runs its own device
-    # program), so enable for latency-sensitive low-concurrency greedy
-    # traffic, not for saturation workloads.
+    # Speculative decoding: registry key of a small dense draft model
+    # sharing the target's vocab ("" → off). With
+    # batching.speculative=on the draft rides INSIDE the continuous
+    # batcher — every decode tick verifies `speculative_gamma` drafted
+    # tokens per target forward against the shared slot pool
+    # (docs/speculative.md; the saturation-workload shape). With it off,
+    # draft-eligible unary calls take the side micro-batcher
+    # (serving/spec_batcher.py) — whole-generation device programs,
+    # best for latency-sensitive low-concurrency greedy traffic.
     speculative_draft: str = ""
     speculative_gamma: int = 4
     # Sequence-parallel prefill over the mesh `sequence` axis: "ring"
@@ -757,6 +772,17 @@ class Config:
             )
         if self.serving.speculative_gamma < 1:
             raise ValueError("speculative_gamma must be >= 1")
+        if self.serving.batching.speculative not in ("off", "on"):
+            raise ValueError("batching.speculative must be 'off' or 'on'")
+        if (
+            self.serving.batching.speculative == "on"
+            and self.serving.kv_ring
+        ):
+            raise ValueError(
+                "batching.speculative does not compose with kv_ring: the "
+                "draft slot-pool cache is contiguous and the (gamma+1)-"
+                "position verify assumes the contiguous length mask"
+            )
         if self.training.steps < 1 or self.training.batch_size < 1:
             raise ValueError("training steps/batch_size must be >= 1")
         if self.training.seq_len < 2:

@@ -55,6 +55,9 @@ _SERVING_HELP = {
     "decode_steps": "fused decode steps issued",
     "speculative_calls": "speculative device calls",
     "speculative_requests": "requests served speculatively",
+    "spec_ticks": "continuous-batcher speculative draft/verify ticks",
+    "spec_drafted": "draft tokens proposed by the spec tick",
+    "spec_accepted": "draft tokens accepted by the spec tick",
     "interleaved_chunks": "prefill chunks fused into decode ticks",
     "interleaved_admissions":
         "requests admitted via tick-interleaved prefill",

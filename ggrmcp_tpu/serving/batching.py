@@ -254,9 +254,30 @@ class ContinuousBatcher:
         self._pipeline = mode == "on" or (
             mode == "auto" and platform == "tpu"
         )
+        # Speculative decoding inside this batcher (batching.speculative
+        # = "on" + a configured draft): every tick becomes one
+        # fixed-shape draft/verify round (ops/speculative.spec_tick) —
+        # gamma draft steps against a per-slot draft cache, one fused
+        # (gamma+1)-position target verify over the shared pool, and
+        # variable advance as per-slot length-pointer arithmetic. The
+        # per-tick advance bound is gamma+1 (not steps_per_tick), so
+        # the overshoot reserve re-derives from it.
+        spec_mode = getattr(self.cfg, "speculative", "off") == "on"
+        self._spec = (
+            spec_mode and getattr(engine, "draft_fam", None) is not None
+        )
+        if spec_mode and not self._spec:
+            logger.warning(
+                "batching.speculative=on but no serving.speculative_draft "
+                "is configured; falling back to the plain tick"
+            )
+        self._gamma = (
+            max(1, int(getattr(engine.serving, "speculative_gamma", 4)))
+            if self._spec else 0
+        )
+        advance = self._gamma + 1 if self._spec else self._steps_per_tick
         self._reserve = (
-            2 * self._steps_per_tick - 1 if self._pipeline
-            else self._steps_per_tick - 1
+            2 * advance - 1 if self._pipeline else advance - 1
         )
         # In-flight dispatched-not-yet-collected ticks, oldest first:
         # (tokens [B, steps] device array, per-slot owner snapshot).
@@ -268,6 +289,12 @@ class ContinuousBatcher:
         # never wraps, so its contiguous layout IS the ring layout);
         # prompts past prefill_chunk take the chunked path as usual.
         self._ring = engine.ring_capacity is not None
+        if self._spec and self._ring:
+            # config.validate mirrors this; batchers built directly in
+            # tests must hit the same wall.
+            raise ValueError(
+                "batching.speculative does not compose with kv_ring"
+            )
         if self._ring:
             engine_chunk = engine.serving.batching.prefill_chunk
             if self.cfg.prefill_chunk > engine_chunk:
@@ -286,6 +313,32 @@ class ContinuousBatcher:
             self._fit_limit = s_max
         self.max_seq = s_max
         self.cache = engine.make_cache(b, s_max)
+        # Spec mode: the draft's KV slot pool rides beside the shared
+        # target cache (the cache-level merge docs/speculative.md's
+        # revisit trigger asked for — one slot pool, draft cache
+        # alongside). Request length additionally clamps to the draft's
+        # RoPE range: a prompt the draft can't position-encode would
+        # silently wreck acceptance. prev_tokens mirrors cur_tokens
+        # (host seed + device twin): the spec round's first draft feed
+        # is [prev, cur] so prev rewrites its own draft-KV slot,
+        # keeping the draft cache exactly one position behind the
+        # target (the speculative_generate invariant).
+        if self._spec:
+            self._fit_limit = min(
+                self._fit_limit, engine.draft_cfg.max_seq_len
+            )
+            self.dcache = engine.make_draft_cache(b, s_max)
+        else:
+            self.dcache = None
+        self.prev_tokens = np.zeros((b,), np.int32)
+        self._prev_dev = None
+        self._dcache_at_risk = False
+        # Spec-tick accounting: ticks run in draft/verify mode, draft
+        # tokens proposed, and proposals accepted — accepted/drafted is
+        # the realized acceptance rate (ServingStats spec_* fields).
+        self.spec_ticks = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         # Host-mirrored per-slot state, pushed to device each tick.
         # cur_tokens additionally keeps a DEVICE-resident twin
         # (_cur_dev): the tick feeds on the previous tick's last-step
@@ -474,6 +527,24 @@ class ContinuousBatcher:
         self._ilv_finish = jax.jit(
             self._ilv_finish_impl, donate_argnums=(0,)
         )
+        # Speculative tick programs (batching.speculative=on): the
+        # draft/verify round (both slot-pool caches donated), its
+        # tick+chunk fusion for interleaved admission (the carried mini
+        # donated too), and the draft-side admission prefill (draft
+        # pool donated — a failed call leaves a rebuilt-zeros pool,
+        # which degrades ACCEPTANCE for live rows but can never break
+        # correctness: exact-match/rejection only ever emits what the
+        # target distribution allows).
+        if self._spec:
+            self._tick_spec = jax.jit(
+                self._tick_spec_impl, donate_argnums=(4, 5)
+            )
+            self._tick_spec_chunk = jax.jit(
+                self._tick_spec_chunk_impl, donate_argnums=(4, 5, 15)
+            )
+            self._spec_admit = jax.jit(
+                self._spec_admit_impl, donate_argnums=(3,)
+            )
 
     def _make_mini(self, rows: int, length: int):
         """Admission mini cache matching the engine's KV storage."""
@@ -759,6 +830,18 @@ class ContinuousBatcher:
             params, tokens, cache, seeds, step, temps, ks, ps, active,
             adapters, gstate, g_allow, g_trans,
         )
+        mini, sel = self._chunk_extend(
+            params, chunk, mini, offs, c_true_len, c_valid, c_adapters
+        )
+        return toks, cache, mini, sel, gstate
+
+    def _chunk_extend(
+        self, params, chunk, mini, offs, c_true_len, c_valid, c_adapters
+    ):
+        """The chunk half of a fused tick+chunk call (shared by the
+        plain and speculative variants): extend the carried [K, S_max]
+        mini cache by one [K, C] chunk at the host-stamped offsets and
+        gather each row's final-prompt-position logits."""
         mini = mini._replace(length=offs)
         c = chunk.shape[1]
         if self._is_moe:
@@ -775,7 +858,83 @@ class ContinuousBatcher:
         last = c_true_len - 1
         idx = jnp.clip(last - offs, 0, c - 1)
         sel = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
-        return toks, cache, mini, sel.astype(jnp.float32), gstate
+        return mini, sel.astype(jnp.float32)
+
+    def _spec_round(
+        self, params, draft_params, prev, tokens, cache, dcache, seeds,
+        step, temps, ks, ps, gstate, g_allow, g_trans,
+    ):
+        """One fixed-shape draft/verify round over the slot pool
+        (ops/speculative.spec_tick wired to this engine's forwards)."""
+        from ggrmcp_tpu.ops.speculative import spec_tick
+
+        return spec_tick(
+            lambda t, c: self.engine.decode_forward(
+                params, t, c, ring=self._ring
+            ),
+            lambda t, c: self.engine.draft_forward(draft_params, t, c),
+            prev, tokens, cache, dcache, self._gamma, seeds, step,
+            temps, ks, ps, gstate, g_allow, g_trans,
+        )
+
+    def _tick_spec_impl(
+        self, params, draft_params, prev, tokens, cache, dcache, seeds,
+        step, temps, ks, ps, gstate, g_allow, g_trans,
+    ):
+        """The speculative tick: ONE device call = gamma draft steps +
+        one (gamma+1)-position target verify for every slot. Returns
+        (emit [B, gamma+1], count [B], cache, dcache, prev', cur',
+        gstate'); the host emits emit[i, :count[i]] per live row —
+        variable advance, fixed shapes (docs/speculative.md)."""
+        return self._spec_round(
+            params, draft_params, prev, tokens, cache, dcache, seeds,
+            step, temps, ks, ps, gstate, g_allow, g_trans,
+        )
+
+    def _tick_spec_chunk_impl(
+        self, params, draft_params, prev, tokens, cache, dcache, seeds,
+        step, temps, ks, ps, gstate, g_allow, g_trans,
+        chunk, mini, offs, c_true_len, c_valid, c_adapters,
+    ):
+        """_tick_spec_impl fused with one [K, C] interleaved-admission
+        prefill chunk — spec mode composes with prefill_interleave the
+        same way the plain tick does (_tick_chunk_impl)."""
+        emit, count, cache, dcache, prev2, cur2, gstate2 = (
+            self._spec_round(
+                params, draft_params, prev, tokens, cache, dcache,
+                seeds, step, temps, ks, ps, gstate, g_allow, g_trans,
+            )
+        )
+        mini, sel = self._chunk_extend(
+            params, chunk, mini, offs, c_true_len, c_valid, c_adapters
+        )
+        return emit, count, cache, dcache, prev2, cur2, gstate2, mini, sel
+
+    def _spec_admit_impl(self, draft_params, tokens, true_len, dcache, slots):
+        """Draft-side admission: fresh draft prefill of the [R, S]
+        right-padded prompts, each row's first S cache positions
+        scattered into the draft slot pool at `slots` (out-of-range
+        padding rows dropped) with length true_len - 1 — one position
+        BEHIND the target, so the first spec round's [prev, cur] feed
+        rewrites the last prompt token's slot (idempotent: same token,
+        same position) and extends from there. One extra small device
+        call per admission round; the target-side admission programs
+        are untouched."""
+        r, s = tokens.shape
+        mini = llama_mod.KVCache.create(
+            self.engine.draft_cfg, r, s, self.engine.kv_dtype
+        )
+        _, mini = self.engine.draft_forward(draft_params, tokens, mini)
+
+        def put(c_, m):
+            return c_.at[:, slots, :s].set(m.astype(c_.dtype), mode="drop")
+
+        k = quant.kv_map(put, dcache.k, mini.k)
+        v = quant.kv_map(put, dcache.v, mini.v)
+        lengths = dcache.length.at[slots].set(
+            jnp.maximum(true_len - 1, 0), mode="drop"
+        )
+        return llama_mod.KVCache(k=k, v=v, length=lengths)
 
     def _ilv_finish_impl(
         self, cache, mini, row, slot, n, sel, seeds, temps, ks, ps,
@@ -1215,15 +1374,42 @@ class ContinuousBatcher:
             jnp.asarray(np.zeros((b,), np.int32)),
             jnp.asarray(zgb), g_allow, g_trans,
         )
-        _, self.cache, _ = self._tick(
-            self.engine.params, jnp.asarray(self.cur_tokens), self.cache,
-            jnp.asarray(self.seeds), jnp.int32(0),
-            jnp.asarray(self.temps), jnp.asarray(self.top_ks),
-            jnp.asarray(self.top_ps),
-            jnp.asarray(np.zeros((b,), bool)),
-            jnp.asarray(np.zeros((b,), np.int32)),
-            jnp.asarray(self.gstates), g_allow, g_trans,
-        )
+        if self._spec:
+            # Spec mode never runs the plain tick — warm the draft/
+            # verify round and the draft-admission prefill (trickle and
+            # full-pool row buckets) instead. Same pre-serving-only
+            # contract: these overwrite rows and advance both length
+            # pointers, harmless while no slot is active.
+            (
+                _, _, self.cache, self.dcache, _, _, _
+            ) = self._tick_spec(
+                self.engine.params, self.engine.draft_params,
+                jnp.asarray(self.prev_tokens),
+                jnp.asarray(self.cur_tokens), self.cache, self.dcache,
+                jnp.asarray(self.seeds), jnp.int32(0),
+                jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+                jnp.asarray(self.top_ps),
+                jnp.asarray(self.gstates), g_allow, g_trans,
+            )
+            for r_rows in (1, b) if b > 1 else (1,):
+                self.dcache = self._spec_admit(
+                    self.engine.draft_params,
+                    jnp.asarray(np.zeros((r_rows, s), np.int32)),
+                    jnp.asarray(np.ones((r_rows,), np.int32)),
+                    self.dcache,
+                    jnp.asarray(np.full((r_rows,), b, np.int32)),
+                )
+        else:
+            _, self.cache, _ = self._tick(
+                self.engine.params, jnp.asarray(self.cur_tokens),
+                self.cache,
+                jnp.asarray(self.seeds), jnp.int32(0),
+                jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+                jnp.asarray(self.top_ps),
+                jnp.asarray(np.zeros((b,), bool)),
+                jnp.asarray(np.zeros((b,), np.int32)),
+                jnp.asarray(self.gstates), g_allow, g_trans,
+            )
         # Fused chunked-admission programs. The long-prompt grid
         # ([B, T, C]) compiles per distinct T — warm the single-chunk
         # grid when the chunked path is reachable (deeper grids compile
@@ -1279,21 +1465,42 @@ class ContinuousBatcher:
             if self._ilv_mini is None:
                 self._ilv_mini = self._make_mini(self._ilv_k, self.max_seq)
             k_rows = self._ilv_k
-            _, self.cache, self._ilv_mini, sel, _ = self._tick_chunk(
-                self.engine.params, jnp.asarray(self.cur_tokens),
-                self.cache, jnp.asarray(self.seeds), jnp.int32(0),
-                jnp.asarray(self.temps), jnp.asarray(self.top_ks),
-                jnp.asarray(self.top_ps),
-                jnp.asarray(np.zeros((b,), bool)),
-                jnp.asarray(np.zeros((b,), np.int32)),
-                jnp.asarray(np.zeros((k_rows, c), np.int32)),
-                self._ilv_mini,
-                jnp.asarray(np.zeros((k_rows,), np.int32)),
-                jnp.asarray(np.ones((k_rows,), np.int32)),
-                jnp.asarray(np.zeros((k_rows,), bool)),
-                jnp.asarray(np.zeros((k_rows,), np.int32)),
-                jnp.asarray(self.gstates), g_allow, g_trans,
-            )
+            if self._spec:
+                (
+                    _, _, self.cache, self.dcache, _, _, _,
+                    self._ilv_mini, sel,
+                ) = self._tick_spec_chunk(
+                    self.engine.params, self.engine.draft_params,
+                    jnp.asarray(self.prev_tokens),
+                    jnp.asarray(self.cur_tokens),
+                    self.cache, self.dcache,
+                    jnp.asarray(self.seeds), jnp.int32(0),
+                    jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+                    jnp.asarray(self.top_ps),
+                    jnp.asarray(self.gstates), g_allow, g_trans,
+                    jnp.asarray(np.zeros((k_rows, c), np.int32)),
+                    self._ilv_mini,
+                    jnp.asarray(np.zeros((k_rows,), np.int32)),
+                    jnp.asarray(np.ones((k_rows,), np.int32)),
+                    jnp.asarray(np.zeros((k_rows,), bool)),
+                    jnp.asarray(np.zeros((k_rows,), np.int32)),
+                )
+            else:
+                _, self.cache, self._ilv_mini, sel, _ = self._tick_chunk(
+                    self.engine.params, jnp.asarray(self.cur_tokens),
+                    self.cache, jnp.asarray(self.seeds), jnp.int32(0),
+                    jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+                    jnp.asarray(self.top_ps),
+                    jnp.asarray(np.zeros((b,), bool)),
+                    jnp.asarray(np.zeros((b,), np.int32)),
+                    jnp.asarray(np.zeros((k_rows, c), np.int32)),
+                    self._ilv_mini,
+                    jnp.asarray(np.zeros((k_rows,), np.int32)),
+                    jnp.asarray(np.ones((k_rows,), np.int32)),
+                    jnp.asarray(np.zeros((k_rows,), bool)),
+                    jnp.asarray(np.zeros((k_rows,), np.int32)),
+                    jnp.asarray(self.gstates), g_allow, g_trans,
+                )
             _, self.cache = self._ilv_finish(
                 self.cache, self._ilv_mini, jnp.int32(0), jnp.int32(0),
                 jnp.int32(0), sel, jnp.asarray(zseed1),
@@ -1501,6 +1708,8 @@ class ContinuousBatcher:
             total += self._pfx_pool.k.nbytes + self._pfx_pool.v.nbytes
         if self._ilv_mini is not None:
             total += self._ilv_mini.k.nbytes + self._ilv_mini.v.nbytes
+        if self.dcache is not None:
+            total += self.dcache.k.nbytes + self.dcache.v.nbytes
         return total
 
     def lat_snapshot(self) -> list[tuple[float, float]]:
@@ -1601,6 +1810,14 @@ class ContinuousBatcher:
             # piggybacked onto decode ticks / requests admitted that way.
             "interleaved_chunks": self.interleaved_chunks,
             "interleaved_admissions": self.interleaved_admissions,
+            # Speculative tick activity (batching.speculative=on):
+            # draft/verify rounds run, draft tokens proposed, and
+            # proposals accepted — spec_accepted/spec_drafted is THIS
+            # batcher's realized acceptance rate (the side micro-
+            # batcher's speculative_drafted/accepted stay separate).
+            "spec_ticks": self.spec_ticks,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
             # Grammar-constrained decoding: tokens emitted under an
             # active DFA mask, and arena table rows currently resident
             # (state 0 + every cached grammar's states). The sidecar
@@ -1788,6 +2005,17 @@ class ContinuousBatcher:
         self.cache = self.engine.make_cache(
             len(self.slots), self.max_seq
         )
+        if self._spec:
+            # The spec tick donated the draft pool alongside the shared
+            # cache; every victim replays through admission, which
+            # re-prefills its draft row, so a fresh pool is complete
+            # recovery (prev mirrors re-stamp there too).
+            self.prev_tokens[:] = 0
+            self._prev_dev = None
+            self._dcache_at_risk = False
+            self.dcache = self.engine.make_draft_cache(
+                len(self.slots), self.max_seq
+            )
 
     def _sweep_expired_pending(self) -> None:
         """Deadline-aware sweep: drop already-expired (and abandoned)
@@ -1897,6 +2125,16 @@ class ContinuousBatcher:
                     "batched prefill failed for slots %s", slots_idx
                 )
                 cache_dead = self._cache_at_risk
+                if self._dcache_at_risk:
+                    # The draft-admission call died mid-donation: its
+                    # pool is gone. A zeroed rebuild degrades live
+                    # rows' ACCEPTANCE only — exact-match/rejection can
+                    # never emit a token the target distribution
+                    # wouldn't, whatever the draft proposes.
+                    self._dcache_at_risk = False
+                    self.dcache = self.engine.make_draft_cache(
+                        len(self.slots), self.max_seq
+                    )
                 activated = {
                     id(s.request) for s in self.slots
                     if s.active and s.request is not None
@@ -1929,6 +2167,46 @@ class ContinuousBatcher:
                 continue
             admitted += len(batch)
         return admitted
+
+    def _spec_admit_rows(self, rows: list[tuple[int, _Request]]) -> None:
+        """Draft-side admission for newly activated slots (spec mode):
+        ONE bucketed [R, S] draft prefill + scatter into the draft slot
+        pool, then the prev-token mirrors. Runs AFTER the target-side
+        activation inside the same serialized executor call, so the
+        next tick (which cannot overlap admission) always sees a draft
+        cache one position behind the target. A failure here only
+        costs acceptance (the rebuilt-zeros pool degrades proposals,
+        never correctness) — the caller's handler rebuilds via
+        _dcache_at_risk."""
+        rows = [
+            (sl, req) for sl, req in rows
+            if self.slots[sl].request is req  # still live (not finished)
+        ]
+        if not self._spec or not rows:
+            return
+        r_b = min(len(self.slots), bucket_len(len(rows), minimum=1))
+        s = bucket_len(
+            max(len(req.prompt) for _, req in rows), maximum=self.max_seq
+        )
+        tokens = np.zeros((r_b, s), np.int32)
+        true_len = np.ones((r_b,), np.int32)
+        slots_arr = np.full((r_b,), len(self.slots), np.int32)  # pad=drop
+        for j, (sl, req) in enumerate(rows):
+            tokens[j, : len(req.prompt)] = req.prompt
+            true_len[j] = len(req.prompt)
+            slots_arr[j] = sl
+        self._dcache_at_risk = True
+        self.dcache = self._spec_admit(
+            self.engine.draft_params, jnp.asarray(tokens),
+            jnp.asarray(true_len), self.dcache, jnp.asarray(slots_arr),
+        )
+        jax.block_until_ready(self.dcache.length)
+        self._dcache_at_risk = False
+        for sl, req in rows:
+            prev = int(req.prompt[-1])
+            self.prev_tokens[sl] = prev
+            if self._prev_dev is not None:
+                self._prev_dev = self._prev_dev.at[sl].set(prev)
 
     def _prefill_into_slots(
         self, slots_idx: list[int], batch: list[_Request]
@@ -2002,6 +2280,12 @@ class ContinuousBatcher:
             self._admit_chunked_group(rows, pfx=(entry, start, width))
         if fused_batch:
             self._prefill_fused(fused_slots, fused_batch)
+        if self._spec:
+            # Draft-side admission for every slot this round activated
+            # (fused, chunked, and prefix paths alike; interleave-queued
+            # rows are draft-admitted by _ilv_finish_row when their
+            # final chunk lands). One bucketed device call per round.
+            self._spec_admit_rows(list(zip(slots_idx, batch)))
         if trickle and batch[0].adapter == 0 and self.slots[
             slots_idx[0]
         ].request is batch[0]:
@@ -2193,7 +2477,12 @@ class ContinuousBatcher:
         # a real device failure at tick dispatch — _loop's handler
         # replays the victims (utils/failpoints.py).
         failpoints.evaluate("tick_fail")
-        if self._ilv_busy():
+        if self._spec:
+            if self._ilv_busy():
+                self._tick_spec_dispatch(chunk=True)
+            else:
+                self._tick_spec_dispatch()
+        elif self._ilv_busy():
             self._tick_dispatch_chunk()
         else:
             self._tick_dispatch()
@@ -2254,15 +2543,121 @@ class ContinuousBatcher:
         # finish (tick N's emission) and be re-admitted before tick
         # N+1's junk row for the old request is collected.
         owners = [s.request if s.active else None for s in self.slots]
-        self._inflight.append((toks, owners, rec))
+        self._inflight.append((toks, None, owners, rec))
         self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
         self.timing["ticks"] += 1
+
+    def _tick_spec_dispatch(self, chunk: bool = False) -> None:
+        """The speculative twin of _tick_dispatch / _tick_dispatch_chunk:
+        one device call = gamma draft steps + one fused (gamma+1)-
+        position verify for the whole pool (plus at most one [K, C]
+        interleaved prefill chunk when `chunk`). Token feedback (cur,
+        prev, grammar state) and both cache length pointers stay
+        device-resident, so spec ticks pipeline exactly like plain
+        ones; the host pulls (emit, count) at collect and advances each
+        slot by its accepted count."""
+        if chunk:
+            self._ilv_fill_rows()
+        t0 = time.perf_counter()
+        step0 = self.step_counter
+        # gamma+1 target positions per round — decode_steps counts
+        # positions processed, and the per-round RNG tag (step0+1)
+        # stays unique across ticks.
+        self.step_counter += self._gamma + 1
+        active = np.array([s.active for s in self.slots], bool)
+        if self._cur_dev is None:
+            self._cur_dev = jnp.asarray(self.cur_tokens)
+        if self._prev_dev is None:
+            self._prev_dev = jnp.asarray(self.prev_tokens)
+        if self._gstate_dev is None:
+            self._gstate_dev = jnp.asarray(self.gstates)
+        g_allow, g_trans = self._grammar_tables()
+        args = (
+            self.engine.params, self.engine.draft_params,
+            self._prev_dev, self._cur_dev, self.cache, self.dcache,
+            jnp.asarray(self.seeds), jnp.int32(step0 + 1),
+            jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+            jnp.asarray(self.top_ps),
+            self._gstate_dev, g_allow, g_trans,
+        )
+        if chunk:
+            (chunk_arr, offs, c_tl, c_valid, c_adapt) = (
+                self._ilv_chunk_inputs()
+            )
+            rec = self._tick_record(active, ilv_rows=int(c_valid.sum()))
+            if self._ilv_mini is None:
+                self._ilv_mini = self._make_mini(self._ilv_k, self.max_seq)
+            (
+                toks, counts, self.cache, self.dcache,
+                prev_out, cur_out, gstate_out, self._ilv_mini, sel,
+            ) = self._tick_spec_chunk(
+                *args, jnp.asarray(chunk_arr), self._ilv_mini,
+                jnp.asarray(offs), jnp.asarray(c_tl),
+                jnp.asarray(c_valid), jnp.asarray(c_adapt),
+            )
+        else:
+            rec = self._tick_record(active)
+            (
+                toks, counts, self.cache, self.dcache,
+                prev_out, cur_out, gstate_out,
+            ) = self._tick_spec(*args)
+        self._cur_dev = cur_out
+        self._prev_dev = prev_out
+        self._gstate_dev = gstate_out
+        try:
+            toks.copy_to_host_async()
+            counts.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        owners = [s.request if s.active else None for s in self.slots]
+        self._inflight.append((toks, counts, owners, rec))
+        self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
+        self.timing["ticks"] += 1
+        self.spec_ticks += 1
+        if chunk:
+            self._ilv_advance(sel)
 
     def _ilv_fill_rows(self) -> None:
         """Claim queued chunk work items into free interleave rows."""
         for r in range(self._ilv_k):
             if self._ilv_rows[r] is None and self._ilv_pending:
                 self._ilv_rows[r] = self._ilv_pending.popleft()
+
+    def _ilv_chunk_inputs(self):
+        """Host-stamped inputs for the chunk half of a fused tick+chunk
+        call (shared by the plain and speculative dispatches)."""
+        k = self._ilv_k
+        c = min(self.cfg.prefill_chunk, self.max_seq)
+        chunk = np.zeros((k, c), np.int32)
+        offs = np.zeros((k,), np.int32)
+        c_tl = np.ones((k,), np.int32)
+        c_valid = np.zeros((k,), bool)
+        c_adapt = np.zeros((k,), np.int32)
+        for r, st in enumerate(self._ilv_rows):
+            if st is None:
+                continue
+            piece = st.request.prompt[st.progress : st.progress + c]
+            chunk[r, : len(piece)] = piece
+            offs[r] = st.progress
+            c_tl[r] = st.n
+            c_valid[r] = True
+            c_adapt[r] = st.request.adapter
+        return chunk, offs, c_tl, c_valid, c_adapt
+
+    def _ilv_advance(self, sel) -> None:
+        """Advance every admitting row by the chunk just dispatched and
+        finish the rows whose final chunk it was."""
+        c = min(self.cfg.prefill_chunk, self.max_seq)
+        done: list[int] = []
+        for r, st in enumerate(self._ilv_rows):
+            if st is None:
+                continue
+            self.interleaved_chunks += 1
+            st.progress += c
+            if st.progress >= st.n:
+                done.append(r)
+        for r in done:
+            self._ilv_finish_row(r, sel)
 
     def _tick_dispatch_chunk(self) -> None:
         """_tick_dispatch's interleaved twin: ONE fused device call =
@@ -2280,22 +2675,7 @@ class ContinuousBatcher:
             self._cur_dev = jnp.asarray(self.cur_tokens)
         if self._ilv_mini is None:
             self._ilv_mini = self._make_mini(self._ilv_k, self.max_seq)
-        k = self._ilv_k
-        c = min(self.cfg.prefill_chunk, self.max_seq)
-        chunk = np.zeros((k, c), np.int32)
-        offs = np.zeros((k,), np.int32)
-        c_tl = np.ones((k,), np.int32)
-        c_valid = np.zeros((k,), bool)
-        c_adapt = np.zeros((k,), np.int32)
-        for r, st in enumerate(self._ilv_rows):
-            if st is None:
-                continue
-            piece = st.request.prompt[st.progress : st.progress + c]
-            chunk[r, : len(piece)] = piece
-            offs[r] = st.progress
-            c_tl[r] = st.n
-            c_valid[r] = True
-            c_adapt[r] = st.request.adapter
+        chunk, offs, c_tl, c_valid, c_adapt = self._ilv_chunk_inputs()
         rec = self._tick_record(active, ilv_rows=int(c_valid.sum()))
         if self._gstate_dev is None:
             self._gstate_dev = jnp.asarray(self.gstates)
@@ -2317,19 +2697,10 @@ class ContinuousBatcher:
         except (AttributeError, RuntimeError):
             pass
         owners = [s.request if s.active else None for s in self.slots]
-        self._inflight.append((toks, owners, rec))
+        self._inflight.append((toks, None, owners, rec))
         self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
         self.timing["ticks"] += 1
-        done: list[int] = []
-        for r, st in enumerate(self._ilv_rows):
-            if st is None:
-                continue
-            self.interleaved_chunks += 1
-            st.progress += c
-            if st.progress >= st.n:
-                done.append(r)
-        for r in done:
-            self._ilv_finish_row(r, sel)
+        self._ilv_advance(sel)
 
     def _ilv_finish_row(self, r: int, sel) -> None:
         """Complete interleave row `r`: scatter its mini row into the
@@ -2352,6 +2723,8 @@ class ContinuousBatcher:
         first_tok = int(np.asarray(first)[0])
         self._ilv_rows[r] = None
         self._activate_slot(st.slot, req, first_tok)
+        if self._spec:
+            self._spec_admit_rows([(st.slot, req)])
 
     def _tick_collect_one(self) -> None:
         """Pull the oldest in-flight tick's tokens to the host and emit
@@ -2359,22 +2732,45 @@ class ContinuousBatcher:
         possibly re-admitted — since dispatch) are dropped: their
         tokens are the junk a parked slot keeps sampling."""
         t0 = time.perf_counter()
-        toks_dev, owners, rec = self._inflight.popleft()
-        toks = np.asarray(toks_dev)  # [B, steps_per_tick]
+        toks_dev, counts_dev, owners, rec = self._inflight.popleft()
+        toks = np.asarray(toks_dev)  # [B, steps_per_tick | gamma+1]
+        # counts is the spec tick's per-row accepted+1 (None on plain
+        # ticks): emission truncates to it, and accepted = count - 1.
+        counts = None if counts_dev is None else np.asarray(counts_dev)
         self.timing["tick_collect_ms"] += (time.perf_counter() - t0) * 1000.0
         self.timing["collects"] += 1
         finished = 0
+        drafted = accepted = 0
         for i, request in enumerate(owners):
             if request is None:
                 continue
+            if counts is not None:
+                drafted += self._gamma
+                accepted += int(counts[i]) - 1
             slot = self.slots[i]
             if slot.request is not request:
                 continue
-            self.cur_tokens[i] = toks[i, -1]
-            self._emit_chunk(i, toks[i])
+            if counts is None:
+                self.cur_tokens[i] = toks[i, -1]
+                self._emit_chunk(i, toks[i])
+            else:
+                c = int(counts[i])
+                # Host mirrors trail the device twins (rebuild seeds
+                # only): cur = the correction token, prev = the token
+                # committed just before it.
+                self.prev_tokens[i] = (
+                    toks[i, c - 2] if c >= 2 else self.cur_tokens[i]
+                )
+                self.cur_tokens[i] = toks[i, c - 1]
+                self._emit_chunk(i, toks[i, :c])
             if self.slots[i].request is not request:
                 finished += 1
-        self.recorder.tick_done(rec, finished)
+        if counts is not None:
+            self.spec_drafted += drafted
+            self.spec_accepted += accepted
+        self.recorder.tick_done(
+            rec, finished, spec_drafted=drafted, spec_accepted=accepted
+        )
 
     def _emit_chunk(self, slot_idx: int, tokens) -> None:
         """Deliver a tick's tokens for one slot: truncate at EOS or the

@@ -486,8 +486,10 @@ class GenerationEngine:
 
     def _init_speculative(self, seed: int) -> None:
         """Build the draft model when speculative decoding is enabled
-        (serving.speculative_draft); greedy-only, lossless (see
-        ops/speculative.py)."""
+        (serving.speculative_draft): greedy exact-match and rejection-
+        sampled modes, lossless either way (ops/speculative.py). The
+        draft serves both the whole-generation micro-path and the
+        continuous batcher's spec tick (batching.speculative)."""
         self.draft_fam = None
         if not self.serving.speculative_draft:
             return
@@ -537,6 +539,18 @@ class GenerationEngine:
                 jax.random.PRNGKey(seed + 1),
             )
         self._spec_fn = jax.jit(self._spec_impl, static_argnums=(4,))
+
+    def draft_forward(self, draft_params, tokens, cache):
+        """fam.forward for the speculative draft model (dense Llama —
+        _init_speculative enforces it; PP/MoE/LoRA are rejected with a
+        draft configured, so none of decode_forward's dispatch cases
+        apply). Used by both the fused whole-generation program
+        (ops/speculative.speculative_generate via _spec_impl) and the
+        continuous batcher's spec tick (serving/batching.py)."""
+        return self.draft_fam.forward(
+            draft_params, self.draft_cfg, tokens, cache,
+            use_flash=self.use_flash, flash_mesh=self.flash_mesh,
+        )
 
     def _spec_impl(
         self, params, draft_params, tokens, true_len, max_new_budget: int,
@@ -737,14 +751,32 @@ class GenerationEngine:
 
     # -- public API ---------------------------------------------------------
 
-    def make_cache(self, batch: int, max_len: int) -> llama_mod.KVCache:
+    def make_draft_cache(self, batch: int, max_len: int) -> llama_mod.KVCache:
+        """Slot-pool KV cache for the speculative DRAFT model (the
+        continuous batcher's spec mode carries one beside the shared
+        target cache). Draft serving is never pipeline-parallel
+        (_init_speculative rejects the combination), so the plain
+        family cache specs apply."""
+        assert self.draft_fam is not None
+        return self.make_cache(batch, max_len, cfg=self.draft_cfg,
+                               fam=self.draft_fam)
+
+    def make_cache(
+        self, batch: int, max_len: int, cfg=None, fam=None
+    ) -> llama_mod.KVCache:
+        """Mesh-sharded KV cache. Default: the target model's geometry
+        (PP-aware); pass cfg/fam to build one for another model sharing
+        the mesh (the speculative draft)."""
+        other = cfg is not None
+        cfg = cfg or self.cfg
+        fam = fam or self.fam
         kv_shape = (
-            self.cfg.num_layers, batch, max_len,
-            self.cfg.num_kv_heads, self.cfg.head_dim,
+            cfg.num_layers, batch, max_len,
+            cfg.num_kv_heads, cfg.head_dim,
         )
         specs = (
-            self._pp.cache_specs_pp() if self.pp_serving
-            else self.fam.cache_specs()
+            self._pp.cache_specs_pp() if self.pp_serving and not other
+            else fam.cache_specs()
         )
         scale_shape = kv_shape[:-1] + (1,)
 
@@ -768,7 +800,7 @@ class GenerationEngine:
         with self.mesh:
             return jax.jit(
                 partial(
-                    llama_mod.KVCache.create, self.cfg, batch, max_len,
+                    llama_mod.KVCache.create, cfg, batch, max_len,
                     self.kv_dtype,
                 ),
                 out_shardings=jax.tree_util.tree_map(

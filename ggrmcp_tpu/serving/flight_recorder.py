@@ -59,6 +59,11 @@ class TickRecord:
     duration_ms: float = 0.0
     finished: int = 0
     source: str = ""
+    # Speculative tick (batching.speculative=on): draft tokens proposed
+    # and accepted on THIS tick — the per-tick acceptance trace (0/0 on
+    # plain ticks). Completed at collect, like finished/duration_ms.
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -74,6 +79,8 @@ class TickRecord:
             "timedOutTotal": self.timed_out_total,
             "traceIds": self.trace_ids,
             "source": self.source,
+            "specDrafted": self.spec_drafted,
+            "specAccepted": self.spec_accepted,
         }
 
 
@@ -197,15 +204,24 @@ class FlightRecorder:
         self._ticks.append(rec)
         return rec
 
-    def tick_done(self, rec: Optional[TickRecord], finished: int) -> None:
+    def tick_done(
+        self,
+        rec: Optional[TickRecord],
+        finished: int,
+        spec_drafted: int = 0,
+        spec_accepted: int = 0,
+    ) -> None:
         """Complete a tick at its token collect: stamp the dispatch→
         collect latency (the tick's real device duration; includes the
-        deliberate one-tick lag under pipelining) and how many requests
-        finished on it."""
+        deliberate one-tick lag under pipelining), how many requests
+        finished on it, and — on speculative ticks — the round's
+        draft/accept counts (the per-tick acceptance trace)."""
         if rec is None:
             return
         rec.duration_ms = (time.perf_counter() - rec.t_mono) * 1000.0
         rec.finished = finished
+        rec.spec_drafted = spec_drafted
+        rec.spec_accepted = spec_accepted
         with self._lock:
             self._hists["tick_duration_ms"].observe(rec.duration_ms)
 

@@ -92,7 +92,16 @@ class Sidecar:
                     self.generation, self.serving.batching,
                     eos_id=self.tokenizer.eos_id,
                 )
-            if self.generation.draft_fam is not None:
+            if (
+                self.generation.draft_fam is not None
+                and self.serving.batching.speculative != "on"
+            ):
+                # The side micro-batcher is the NO-SLOT-POOL fallback:
+                # with batching.speculative=on the continuous batcher
+                # runs the draft/verify round inside its own tick
+                # (shared slot pool, top-k/top-p and grammar rows
+                # included — docs/speculative.md), so every request
+                # routes there and no second pool splits the HBM.
                 from ggrmcp_tpu.serving.spec_batcher import SpeculativeBatcher
 
                 self.spec_batcher = SpeculativeBatcher(
@@ -256,23 +265,22 @@ class Sidecar:
         sampling = self._sampling(request)
         adapter = await self._resolve_adapter(request, context)
         grammar = await self._resolve_grammar(request, context)
-        # Draft-assisted path: greedy requests (lossless, bitwise) and
-        # plain temperature sampling (rejection sampling — lossless in
-        # distribution, ops/speculative.py). top-k/top-p filtering is
-        # not implemented in the rejection sampler, so those requests
-        # take the continuous batcher — as do LONG prompts: speculative
-        # decoding wins on decode-bound traffic, but a long prompt is
-        # prefill-bound and the draft model would DOUBLE its prefill
-        # cost while bypassing the machinery built for it (chunked
-        # admission, length tiers, the prefix pool). Adapters can't
-        # reach this gate: lora + speculative_draft is rejected at
-        # engine init (engine._init_lora), so a draft-configured
-        # sidecar resolves every request to the base model.
-        # Constrained rows reject into the normal path: the speculative
-        # micro-batch has no grammar mask, and a drafted token the DFA
-        # forbids would break the conformance guarantee.
+        # Side micro-batcher path (the no-slot-pool fallback — absent
+        # when batching.speculative=on puts the draft/verify round
+        # inside the continuous batcher's tick, where top-k/top-p and
+        # grammar rows ARE handled): greedy requests (lossless,
+        # bitwise) and plain temperature sampling (rejection sampling —
+        # lossless in distribution, ops/speculative.py). The micro-
+        # batcher's own program still has no per-row top-k/top-p or
+        # grammar mask, so those requests take the continuous batcher —
+        # as do LONG prompts: speculative decoding wins on decode-bound
+        # traffic, but a long prompt is prefill-bound and the draft
+        # model would DOUBLE its prefill cost while bypassing the
+        # machinery built for it (chunked admission, length tiers, the
+        # prefix pool). Adapters can't reach this gate: lora +
+        # speculative_draft is rejected at engine init.
         speculative = (
-            self.generation.draft_fam is not None
+            self.spec_batcher is not None
             and sampling.top_k <= 0
             and sampling.top_p >= 1.0
             and len(prompt) <= self.serving.batching.prefill_chunk
@@ -587,6 +595,8 @@ class Sidecar:
                     shed_total=t.shed_total, replayed_total=t.replayed_total,
                     timed_out_total=t.timed_out_total,
                     trace_ids=t.trace_ids, source=t.source,
+                    spec_drafted=t.spec_drafted,
+                    spec_accepted=t.spec_accepted,
                 )
                 for t in ticks
             ],
@@ -692,7 +702,11 @@ class Sidecar:
             await asyncio.get_running_loop().run_in_executor(
                 None, self.batcher.warmup
             )
-            if self.generation is not None:
+            if self.generation is not None and self.spec_batcher is not None:
+                # The whole-generation speculative program only serves
+                # the side micro-batcher; with batching.speculative=on
+                # the batcher's own warmup compiled the spec tick and
+                # this compile would be pure wasted window.
                 await asyncio.get_running_loop().run_in_executor(
                     None, self.generation.warmup_speculative
                 )

@@ -1,4 +1,13 @@
-"""Micro-batching for speculative decoding.
+"""Micro-batching for speculative decoding — the NO-SLOT-POOL fallback.
+
+Since round 6 the primary speculative path lives INSIDE the continuous
+batcher (`batching.speculative=on` → `serving/batching.py` runs a
+fixed-shape draft/verify round per tick against the shared slot pool;
+docs/speculative.md). With that flag on, the sidecar does not construct
+this collector at all. It remains the draft-assisted micro-path for
+`off` deployments: latency-sensitive, low-concurrency greedy/plain-
+temperature unary traffic where a whole-generation device program per
+coalesced group beats slot-pool scheduling.
 
 Round 1 routed every greedy+draft request to a private
 `generate_speculative([prompt])` device program, serialized on the

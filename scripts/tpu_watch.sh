@@ -114,6 +114,21 @@ have_attn()  {
     && grep -q 'ATTN-BENCH-COMPLETE' "$ART/attn_bench.txt"
 }
 
+# Minimal capture (VERDICT r6 item 1): headline phase only on the
+# flagship llama-1b geometry, one flat pool, no secondary phases —
+# completes in ~3 minutes once the compile cache is warm, so even a
+# brief tunnel window banks a NON-STALE round number before the fuller
+# stages start. bench_tpu_min.json is last-preference in bench.py's
+# banked-line order (any fuller capture supersedes it).
+stage_minimal() {
+  note "stage llama-1b minimal: start"
+  GGRMCP_BENCH_MINIMAL=1 GGRMCP_BENCH_SESSIONS=16 GGRMCP_BENCH_CALLS=160 \
+    GGRMCP_BENCH_BUDGET_S=420 timeout 480 python bench.py 9>&- \
+    > "$ART/bench_tpu_min.json" 2> "$ART/bench_tpu_min.err"
+  note "stage llama-1b minimal: rc=$? on_chip=$(have_bench bench_tpu_min.json && echo yes || echo no)"
+  have_bench bench_tpu_min.json
+}
+
 stage_tiny() {
   note "stage tiny-llama: start"
   GGRMCP_BENCH_MODEL=tiny-llama-8k GGRMCP_BENCH_SESSIONS=8 GGRMCP_BENCH_CALLS=64 \
@@ -228,6 +243,26 @@ stage_1b_nopipe() {
   have_bench bench_tpu_int8_nopipe.json
 }
 
+# Speculative continuous batching A/B (ISSUE 5): the specbatch phase
+# builds its own draft-configured engine and runs batching.speculative
+# off vs on over the same decode-bound workload — tokens/s uplift,
+# realized acceptance, per-tick draft overhead (specbatch_* keys).
+# SPECBATCH=on overrides the headline-only gate, so the stage pays one
+# quick headline + the A/B, not the full phase ladder. Default draft =
+# the target itself (independently initialized weights — honest
+# acceptance mechanics; a checkpointed small draft would be the
+# production shape).
+stage_1b_spec() {
+  note "stage llama-1b int8 specbatch: start"
+  GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_KV=int8 \
+    GGRMCP_BENCH_SESSIONS=16 GGRMCP_BENCH_CALLS=64 \
+    GGRMCP_BENCH_HEADLINE_ONLY=1 GGRMCP_BENCH_SPECBATCH=on \
+    GGRMCP_BENCH_BUDGET_S=1200 timeout 1300 python bench.py 9>&- \
+    > "$ART/bench_tpu_spec.json" 2> "$ART/bench_tpu_spec.err"
+  note "stage llama-1b int8 specbatch: rc=$? on_chip=$(have_bench bench_tpu_spec.json && echo yes || echo no)"
+  have_bench bench_tpu_spec.json
+}
+
 # Rebank: the first window's full-phase artifacts were captured before
 # pipelined ticks landed (synchronous loop, tick=8). A later window
 # re-runs the flagship stage with the improved serving loop and
@@ -251,9 +286,11 @@ stage_rebank_1b() {
 }
 
 all_done() {
-  have_bench bench_tpu_tiny.json && have_bench bench_tpu.json \
+  have_bench bench_tpu_min.json \
+    && have_bench bench_tpu_tiny.json && have_bench bench_tpu.json \
     && have_attn && have_bench bench_tpu_int8.json \
     && have_bench bench_tpu_8b.json \
+    && have_bench bench_tpu_spec.json \
     && have_bench bench_tpu_int8_t16.json \
     && have_bench bench_tpu_8b_t16.json \
     && have_bench bench_tpu_int8_s64.json \
@@ -263,6 +300,9 @@ all_done() {
 }
 
 run_ladder() {
+  # Minimal first: one non-stale flagship-geometry round number in the
+  # bank before anything heavier gets a chance to eat the window.
+  have_bench bench_tpu_min.json  || stage_minimal || probe || return 1
   have_bench bench_tpu_tiny.json || stage_tiny || probe || return 1
   have_bench bench_tpu.json      || stage_1b   || probe || return 1
   have_attn                      || stage_attn || probe || return 1
@@ -272,6 +312,7 @@ run_ladder() {
   # fresh full-phase flagship capture (which feeds BENCH_r{N}) is
   # worth more than the tuning points.
   [ -f "$ART/.rebanked_1b" ] || stage_rebank_1b || probe || return 1
+  have_bench bench_tpu_spec.json || stage_1b_spec || probe || return 1
   have_bench bench_tpu_int8_s64.json || stage_1b_s64 || probe || return 1
   have_bench bench_tpu_8b_s64.json   || stage_8b_s64 || probe || return 1
   have_bench bench_tpu_int8_t16.json || stage_1b_t16 || probe || return 1

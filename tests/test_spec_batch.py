@@ -1,0 +1,481 @@
+"""Speculative decoding inside the continuous batcher (ISSUE 5,
+marker `spec_batch`): the fixed-shape draft/verify tick behind
+`batching.speculative=on`.
+
+The load-bearing guarantees:
+
+  * Greedy bitwise identity — with a draft configured, spec-on output
+    is BYTE-identical to spec-off across every admission path (fused
+    single/burst, chunked, prefix-pool, tick-interleaved) and under
+    injected tick faults (chaos replay). Exact-match acceptance makes
+    this hold REGARDLESS of draft quality.
+  * Sampled losslessness — emitted tokens are distributed exactly as
+    plain target sampling over the per-row temp→top-k→top-p FILTERED
+    distribution (the rejection-sampler extension this issue adds),
+    pinned by TV-distance against the exact conditional (carried over
+    from tests/test_speculative.py).
+  * Fixed shapes — mixed greedy/sampled/top-k/constrained batches
+    share ONE compiled spec tick (compile-count stability).
+
+Deliberately NOT slow-marked: tier-1 always runs the spec tick;
+`make test-spec-batch` selects it alone.
+"""
+
+import asyncio
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.core.config import BatchingConfig, MeshConfig, ServingConfig
+from ggrmcp_tpu.grammar import compile_schema
+from ggrmcp_tpu.models import llama
+from ggrmcp_tpu.ops.sampling import SamplingConfig
+from ggrmcp_tpu.serving.batching import ContinuousBatcher
+from ggrmcp_tpu.serving.engine import GenerationEngine
+from ggrmcp_tpu.serving.tokenizer import ByteTokenizer
+from ggrmcp_tpu.utils import failpoints
+
+pytestmark = pytest.mark.spec_batch
+
+GREEDY = SamplingConfig(temperature=0.0)
+TOK = ByteTokenizer()
+VOCAB = llama.CONFIGS["tiny-llama"].vocab_size
+
+
+def spec_cfg(**kw) -> ServingConfig:
+    kw.setdefault("model", "tiny-llama")
+    kw.setdefault("mesh", MeshConfig(tensor=2, data=0))
+    kw.setdefault("speculative_draft", "tiny-llama")
+    return ServingConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    # Draft = same architecture, DIFFERENT random params (seed offset
+    # in _init_speculative): realistic imperfect-draft acceptance.
+    return GenerationEngine(llama.CONFIGS["tiny-llama"], spec_cfg())
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints.registry.disarm()
+    yield
+    failpoints.registry.disarm()
+
+
+def _batcher(engine, spec: bool, **cfg_kw) -> ContinuousBatcher:
+    cfg_kw.setdefault("max_batch_size", 4)
+    cfg_kw.setdefault("kv_cache_max_seq", 256)
+    cfg = BatchingConfig(
+        speculative=("on" if spec else "off"), **cfg_kw
+    )
+    return ContinuousBatcher(engine, cfg)
+
+
+async def _drain(batcher, prompt, max_new, sampling=GREEDY, seed=0,
+                 grammar=None):
+    out, reason = [], None
+    async for ids, reason in batcher.submit(
+        prompt, max_new, sampling, seed=seed, grammar=grammar
+    ):
+        out.extend(ids)
+    return out, reason
+
+
+async def _run_all(engine, prompts, max_new, spec, seeds=None, **cfg_kw):
+    """Drain `prompts` concurrently through one batcher; returns
+    ([(tokens, reason)], batcher)."""
+    batcher = _batcher(engine, spec, **cfg_kw)
+    batcher.start()
+    try:
+        results = await asyncio.gather(*(
+            _drain(batcher, p, max_new,
+                   seed=(seeds[i] if seeds else i))
+            for i, p in enumerate(prompts)
+        ))
+        return results, batcher
+    finally:
+        await batcher.stop()
+
+
+LONG = [(i * 7) % 200 + 3 for i in range(90)]  # > prefill_chunk=32
+
+
+class TestGreedyBitwiseIdentity:
+    """THE acceptance property: spec-on greedy output is byte-identical
+    to spec-off on every admission path."""
+
+    async def test_fused_burst_and_trickle(self, engine):
+        prompts = [[3, 1, 4, 1], [2, 7, 1], [5, 5, 5, 5, 5], [9, 9]]
+        off, _ = await _run_all(engine, prompts, 10, spec=False)
+        on, b = await _run_all(engine, prompts, 10, spec=True)
+        assert on == off
+        assert b.spec_ticks > 0 and b.spec_drafted > 0
+        # Trickle (single-row admission program) too.
+        off1, _ = await _run_all(engine, [[8, 6, 7]], 9, spec=False)
+        on1, _ = await _run_all(engine, [[8, 6, 7]], 9, spec=True)
+        assert on1 == off1
+
+    async def test_chunked_admission(self, engine):
+        off, _ = await _run_all(
+            engine, [LONG], 8, spec=False, prefill_chunk=32
+        )
+        on, _ = await _run_all(
+            engine, [LONG], 8, spec=True, prefill_chunk=32
+        )
+        assert on == off
+
+    async def test_prefix_pool_admission(self, engine):
+        """Wave 1 seeds the pool, wave 2 reuses it — spec-on must match
+        spec-off through both the cold store and the fused prefix-hit
+        program (the draft side always prefills the FULL prompt; only
+        the target reuses pooled KV)."""
+        preamble = [(i * 5) % 150 + 3 for i in range(24)]
+        kw = dict(
+            prefix_cache_entries=2, prefix_cache_min_seq=8,
+            prefix_cache_max_seq=64,
+        )
+        outs = {}
+        for spec in (False, True):
+            batcher = _batcher(engine, spec, **kw)
+            batcher.start()
+            try:
+                seed_wave = await _drain(
+                    batcher, preamble + [7, 7], 8
+                )
+                hit_wave = await asyncio.gather(*(
+                    _drain(batcher, preamble + [9, i], 8, seed=i)
+                    for i in range(3)
+                ))
+                outs[spec] = (seed_wave, hit_wave)
+                if spec:
+                    assert batcher.prefix_hits > 0, (
+                        "prefix path not exercised"
+                    )
+            finally:
+                await batcher.stop()
+        assert outs[True] == outs[False]
+
+    async def test_interleaved_admission(self, engine):
+        """A long prompt landing while another slot decodes takes the
+        tick-interleaved chunk path (spec tick fused with the chunk);
+        output must still match spec-off exactly."""
+        outs = {}
+        for spec in (False, True):
+            batcher = _batcher(
+                engine, spec, prefill_chunk=32, prefill_interleave="on",
+                prefill_interleave_rows=2,
+            )
+            batcher.start()
+            try:
+                bg = asyncio.ensure_future(
+                    _drain(batcher, [4, 2, 4], 48, seed=1)
+                )
+                await asyncio.sleep(0.05)  # bg decodes before LONG lands
+                long_res = await _drain(batcher, LONG, 8, seed=2)
+                bg_res = await bg
+                outs[spec] = (bg_res, long_res)
+                if spec:
+                    assert batcher.interleaved_admissions > 0, (
+                        "interleave path not exercised"
+                    )
+            finally:
+                await batcher.stop()
+        assert outs[True] == outs[False]
+
+    async def test_chaos_replay_bit_identity(self, engine):
+        """Injected tick faults: victims replay with their emitted
+        prefix, the draft cache re-prefills at re-admission, and greedy
+        spec-on output stays byte-identical to the fault-free run."""
+        prompts = [[3, 1, 4, 1], [2, 7, 1], [5, 5, 5, 5, 5], [9, 9]]
+        baseline, _ = await _run_all(engine, prompts, 8, spec=True)
+        failpoints.registry.arm("tick_fail", every=3)
+        faulted, chaos_b = await _run_all(
+            engine, prompts, 8, spec=True, tick_retry_limit=32
+        )
+        failpoints.registry.disarm()
+        assert chaos_b.replayed > 0, "no fault was actually injected"
+        assert chaos_b.replay_exhausted == 0
+        assert faulted == baseline
+
+
+class TestConstrainedRows:
+    """Grammar-constrained rows verify against the DFA mask inside the
+    spec tick (states advanced along the proposal path)."""
+
+    SCHEMA = {
+        "type": "object",
+        "properties": {
+            "ok": {"type": "boolean"},
+            "label": {"type": "string", "maxLength": 4},
+        },
+        "required": ["ok", "label"],
+    }
+
+    async def test_constrained_greedy_matches_spec_off(self, engine):
+        g = compile_schema(self.SCHEMA, vocab_size=VOCAB)
+        outs = {}
+        for spec in (False, True):
+            batcher = _batcher(engine, spec)
+            batcher.start()
+            try:
+                outs[spec] = await _drain(
+                    batcher, [3, 1, 4, 1], 256, grammar=g
+                )
+            finally:
+                await batcher.stop()
+        assert outs[True] == outs[False]
+        out, reason = outs[True]
+        assert reason in ("grammar_complete", "stop")
+        text = TOK.decode(out)
+        value = json.loads(text)
+        assert value.get("ok") in (True, False)
+        assert g.matches(text)
+
+    async def test_mixed_batch_compile_count_stable(self, engine):
+        """Mixed greedy / sampled / top-k/top-p / constrained rows all
+        ride ONE compiled spec tick — running them adds zero compiles
+        after warmup (the fixed-shape contract)."""
+        g = compile_schema(self.SCHEMA, vocab_size=VOCAB)
+        batcher = _batcher(engine, spec=True)
+        batcher.start()
+        try:
+            await _drain(batcher, [3, 1, 4], 8)  # warm the spec tick
+            before = batcher._tick_spec._cache_size()
+            results = await asyncio.gather(
+                _drain(batcher, [3, 1, 4], 8),
+                _drain(batcher, [5, 5, 5], 8,
+                       sampling=SamplingConfig(temperature=0.9), seed=7),
+                _drain(batcher, [2, 7], 8,
+                       sampling=SamplingConfig(
+                           temperature=0.8, top_k=5, top_p=0.9
+                       ), seed=11),
+                _drain(batcher, [9, 2], 256, grammar=g),
+            )
+            for out, reason in results:
+                assert len(out) >= 1
+                assert reason in (
+                    "stop", "length", "grammar_complete"
+                )
+            assert batcher._tick_spec._cache_size() == before
+        finally:
+            await batcher.stop()
+
+
+NANO = llama.LlamaConfig(
+    name="nano-llama-sb", vocab_size=8, hidden_dim=32, num_layers=2,
+    num_heads=2, num_kv_heads=2, head_dim=16, ffn_dim=64,
+    max_seq_len=64, dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def nano_engine():
+    """Tiny-vocab (8) engine + imperfect draft: small enough that an
+    empirical output histogram can be compared against the exact model
+    distribution (same construction as tests/test_speculative.py)."""
+    llama.CONFIGS["nano-llama-sb"] = NANO
+    try:
+        yield GenerationEngine(
+            NANO, spec_cfg(model="nano-llama-sb",
+                           speculative_draft="nano-llama-sb"),
+        )
+    finally:
+        del llama.CONFIGS["nano-llama-sb"]
+
+
+async def _second_token_pairs(engine, sampling, waves, rows, eos=2):
+    """(t0, t1) pairs from max_new=2 spec-batched generations with
+    distinct per-row seeds; stripped EOS reconstructed (the batcher
+    consumes the terminal EOS as finish_reason 'stop')."""
+    batcher = _batcher(engine, spec=True, max_batch_size=rows)
+    batcher.start()
+    pairs = []
+    try:
+        for wave in range(waves):
+            results = await asyncio.gather(*(
+                _drain(batcher, [3, 1, 4], 2, sampling=sampling,
+                       seed=wave * rows + i)
+                for i in range(rows)
+            ))
+            for ids, reason in results:
+                if len(ids) == 2:
+                    pairs.append((ids[0], ids[1]))
+                elif len(ids) == 1 and reason == "stop":
+                    pairs.append((ids[0], eos))
+    finally:
+        await batcher.stop()
+    return pairs
+
+
+def _exact_conditional(engine, prompt, filt=None):
+    """Exact second-token conditional: target softmax after prompt,
+    optionally restricted to `filt(probs) -> mask` support."""
+    import jax.numpy as jnp
+
+    logits, _ = llama.forward(
+        dict(engine.params), NANO, jnp.asarray([prompt], jnp.int32)
+    )
+    exact = np.asarray(
+        jax.nn.softmax(np.asarray(logits)[0, -1].astype(np.float64))
+    )
+    if filt is not None:
+        mask = filt(exact)
+        exact = np.where(mask, exact, 0.0)
+        exact /= exact.sum()
+    return exact
+
+
+class TestSampledLossless:
+    """The TV-distance net carried over from tests/test_speculative.py:
+    the spec TICK's rejection sampler (accept + residual against an
+    imperfect draft) must emit second tokens distributed exactly as
+    plain target sampling — and, with top-k set, as the top-k FILTERED
+    target distribution (the lossless extension this issue adds)."""
+
+    def _check(self, engine, pairs, filt=None, bound=0.15):
+        firsts = [p[0] for p in pairs]
+        assert firsts, "all rows stopped at zero tokens"
+        modal = max(set(firsts), key=firsts.count)
+        seconds = [p[1] for p in pairs if p[0] == modal]
+        assert len(seconds) >= 150, "not enough conditional samples"
+        emp = np.bincount(
+            seconds, minlength=NANO.vocab_size
+        ).astype(float)
+        emp /= emp.sum()
+        exact = _exact_conditional(engine, [3, 1, 4, modal], filt)
+        tv = 0.5 * np.abs(emp - exact).sum()
+        assert tv < bound, (
+            f"spec-batched second-token TV distance {tv:.3f} "
+            f"(emp {np.round(emp, 3)}, exact {np.round(exact, 3)})"
+        )
+
+    async def test_plain_temperature_distribution(self, nano_engine):
+        pairs = await _second_token_pairs(
+            nano_engine, SamplingConfig(temperature=1.0),
+            waves=14, rows=64,
+        )
+        self._check(nano_engine, pairs)
+
+    async def test_top_k_filtered_distribution(self, nano_engine):
+        """top-k rows rejection-sample over the FILTERED p and q: the
+        emitted distribution must match the top-3-renormalized target
+        conditional — and never leave the top-3 support."""
+        k = 3
+        pairs = await _second_token_pairs(
+            nano_engine, SamplingConfig(temperature=1.0, top_k=k),
+            waves=14, rows=64,
+        )
+
+        def topk_mask(probs):
+            kth = np.sort(probs)[-k]
+            return probs >= kth
+
+        self._check(nano_engine, pairs, filt=topk_mask)
+        # Support check is exact, not statistical: conditioned on ANY
+        # first token, every second token lies in that prefix's top-k.
+        by_first = {}
+        for t0, t1 in pairs:
+            by_first.setdefault(t0, set()).add(t1)
+        for t0, seconds in by_first.items():
+            exact = _exact_conditional(nano_engine, [3, 1, 4, t0])
+            allowed = set(np.argsort(exact)[-k:].tolist())
+            assert seconds <= allowed, (t0, seconds, allowed)
+
+
+class TestStatsAndSidecar:
+    async def test_spec_counters_flow_to_proto(self, engine):
+        from ggrmcp_tpu.rpc.pb import serving_pb2
+
+        _, b = await _run_all(engine, [[3, 1, 4]], 8, spec=True)
+        stats = b.stats()
+        assert stats["spec_ticks"] == b.spec_ticks > 0
+        assert stats["spec_drafted"] >= stats["spec_accepted"] >= 0
+        # Loud-drift contract: every stats key is a proto field.
+        resp = serving_pb2.ServingStatsResponse(**stats)
+        assert resp.spec_ticks == b.spec_ticks
+        # Per-tick acceptance reaches the flight recorder ring.
+        ticks, _ = b.flight_snapshot(max_ticks=64)
+        assert any(t.spec_drafted > 0 for t in ticks)
+        assert all(
+            0 <= t.spec_accepted <= t.spec_drafted for t in ticks
+        )
+
+    async def test_sidecar_routes_everything_to_batcher(self):
+        """With batching.speculative=on the side micro-batcher is NOT
+        constructed — the continuous batcher serves draft-eligible
+        requests (spec_ticks move) and outputs stay well-formed."""
+        import grpc
+        import grpc.aio
+
+        from ggrmcp_tpu.rpc.pb import serving_pb2
+        from ggrmcp_tpu.serving.sidecar import Sidecar
+
+        side = Sidecar(spec_cfg(
+            batching=BatchingConfig(
+                max_batch_size=2, kv_cache_max_seq=256, speculative="on"
+            ),
+        ))
+        assert side.spec_batcher is None
+        port = await side.start(0)
+        channel = grpc.aio.insecure_channel(f"localhost:{port}")
+        try:
+            gen = channel.unary_unary(
+                "/ggrmcp.tpu.GenerateService/Generate",
+                request_serializer=(
+                    serving_pb2.GenerateRequest.SerializeToString
+                ),
+                response_deserializer=(
+                    serving_pb2.GenerateResponse.FromString
+                ),
+            )
+            resp = await gen(serving_pb2.GenerateRequest(
+                prompt="spec", max_new_tokens=6, return_tokens=True
+            ))
+            assert resp.completion_tokens == len(resp.token_ids) <= 6
+            assert resp.finish_reason in ("length", "stop")
+            stats_fn = channel.unary_unary(
+                "/ggrmcp.tpu.ModelInfoService/GetServingStats",
+                request_serializer=(
+                    serving_pb2.ServingStatsRequest.SerializeToString
+                ),
+                response_deserializer=(
+                    serving_pb2.ServingStatsResponse.FromString
+                ),
+            )
+            stats = await stats_fn(serving_pb2.ServingStatsRequest())
+            assert stats.spec_ticks > 0
+            assert stats.spec_drafted > 0
+            # The side micro-batcher's counters stay zero — nothing
+            # routed around the slot pool.
+            assert stats.speculative_calls == 0
+        finally:
+            await channel.close()
+            await side.stop()
+
+    def test_spec_without_draft_falls_back(self):
+        """speculative=on with NO draft configured must degrade to the
+        plain tick, loudly but functionally."""
+        eng = GenerationEngine(
+            llama.CONFIGS["tiny-llama"],
+            ServingConfig(
+                model="tiny-llama", mesh=MeshConfig(tensor=2, data=0)
+            ),
+        )
+        b = _batcher(eng, spec=True)
+        assert b._spec is False and b.dcache is None
+
+    def test_config_rejects_bad_values(self):
+        from ggrmcp_tpu.core import config as cfgmod
+
+        cfg = cfgmod.default()
+        cfg.serving.batching.speculative = "maybe"
+        with pytest.raises(ValueError, match="speculative"):
+            cfg.validate()
+        cfg.serving.batching.speculative = "on"
+        cfg.serving.model = "tiny-mistral"
+        cfg.serving.kv_ring = True
+        with pytest.raises(ValueError, match="kv_ring"):
+            cfg.validate()
