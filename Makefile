@@ -6,8 +6,8 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: proto proto-check descriptors test test-all test-fast test-chaos \
-  test-obs test-grammar test-spec-batch bench-cpu smoke e2e lint ci-local \
-  preflight clean
+  test-obs test-grammar test-spec-batch test-paged bench-cpu smoke e2e \
+  lint ci-local preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
 # No protoc on this image? scripts/regen_serving_pb2.py regenerates
@@ -70,6 +70,15 @@ test-grammar:
 # these too; this target is the fast inner loop for spec-tick work.
 test-spec-batch:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m spec_batch
+
+# Paged KV cache alone (CPU mesh): allocator bookkeeping, greedy
+# bitwise identity paged-on vs paged-off across every admission path
+# (chaos/speculative/grammar/int8 included), refcounted prefix sharing
+# + copy-on-write, typed page-exhaustion shed, composition validation.
+# Tier-1 runs these too; this target is the fast inner loop for
+# serving/pages.py + paged-batcher work.
+test-paged:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m paged
 
 # CPU smoke of the full bench, including the mixed long-prompt+decode
 # workload phase (interleaved prefill on — A/B the serialized baseline

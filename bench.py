@@ -60,6 +60,12 @@ Environment knobs:
                          (default: the target model itself — same
                          architecture, independently initialized
                          weights unless a checkpoint is configured).
+  GGRMCP_BENCH_PAGED     paged KV cache A/B phase ("on" by default
+                         off-TPU, "off" skips): runs batching.paged_kv
+                         on vs off on the same engine over a shared-
+                         preamble agentic workload and exports tokens/s,
+                         prefix hit rates, and KV HBM in use for both
+                         modes (paged_* extras; docs/paged_kv.md).
   GGRMCP_BENCH_CPU=1     force the CPU platform (tiny model)
 """
 
@@ -1143,6 +1149,21 @@ async def _run_bench() -> dict:
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: specbatch phase failed: {exc!r}", file=sys.stderr)
 
+    # Paged KV A/B (GGRMCP_BENCH_PAGED, docs/paged_kv.md): same
+    # isolation rationale as the specbatch phase — runs after the
+    # serving stack is down, on its own batchers.
+    paged = {}
+    want_paged = os.environ.get("GGRMCP_BENCH_PAGED")
+    if want_paged == "on" or (
+        want_paged is None and not headline_only and not on_tpu
+    ):
+        try:
+            paged = await _paged_bench(
+                model, max_new, tick_steps, quantize, kv_dtype, synth,
+            )
+        except Exception as exc:  # secondary phase must not sink the run
+            print(f"bench: paged phase failed: {exc!r}", file=sys.stderr)
+
     proxy = {}
     if not headline_only:
         try:
@@ -1151,7 +1172,117 @@ async def _run_bench() -> dict:
             print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
     return {
         **headline, **hbm, **prefix, **longp, **mixed, **grammar,
-        **ticktime, **specbatch, **proxy,
+        **ticktime, **specbatch, **paged, **proxy,
+    }
+
+
+async def _paged_bench(
+    model: str, max_new: int, tick_steps, quantize: str, kv_dtype: str,
+    synth: bool,
+) -> dict:
+    """Paged KV cache A/B (docs/paged_kv.md): ONE engine, two batchers
+    — batching.paged_kv off then on — driven by the same agentic
+    shared-preamble workload (sessions cycling over a handful of
+    distinct 64-token preambles with per-call question suffixes, the
+    shape the paged allocator's prefix sharing serves). Exports
+    tokens/s both ways, each mode's prefix hit rate, and the KV HBM
+    each holds — the paged win is the hit rate + exact-fit memory at a
+    working set the slot-granular pool would thrash on."""
+    import asyncio as _asyncio
+
+    from ggrmcp_tpu.core.config import (
+        BatchingConfig, MeshConfig, ObservabilityConfig, ServingConfig,
+    )
+    from ggrmcp_tpu.models import get_model
+    from ggrmcp_tpu.ops.sampling import SamplingConfig
+    from ggrmcp_tpu.serving.batching import ContinuousBatcher
+    from ggrmcp_tpu.serving.engine import GenerationEngine
+
+    _, mcfg = get_model(model)
+    engine = GenerationEngine(mcfg, ServingConfig(
+        model=model,
+        quantize=quantize,
+        kv_cache_dtype=kv_dtype,
+        synthetic_weights=synth,
+        mesh=MeshConfig(tensor=0),
+        observability=ObservabilityConfig(enabled=False),
+    ))
+    slots = int(os.environ.get("GGRMCP_BENCH_PAGED_SLOTS", "8"))
+    n_preambles = 6
+    calls = 4 * slots
+    preambles = [
+        [(i * 13 + p * 71 + 5) % 199 + 3 for i in range(64)]
+        for p in range(n_preambles)
+    ]
+    greedy = SamplingConfig(temperature=0.0)
+    loop = _asyncio.get_running_loop()
+    runs: dict[str, dict] = {}
+    for mode in ("off", "on"):
+        batcher = ContinuousBatcher(engine, BatchingConfig(
+            max_batch_size=slots,
+            kv_cache_max_seq=512,
+            decode_steps_per_tick=tick_steps,
+            paged_kv=mode,
+            paged_kv_page_size=16,
+            # The off-mode gets the slot-granular pool the paged plane
+            # replaces, sized to its defaults-at-scale shape: fewer
+            # entries than distinct preambles, i.e. the thrash regime.
+            prefix_cache_entries=0 if mode == "on" else 4,
+            prefix_cache_min_seq=32,
+            prefix_cache_max_seq=128,
+        ))
+        await loop.run_in_executor(None, batcher.warmup)
+        batcher.start()
+        try:
+            async def call(i: int, b=batcher):
+                out = []
+                async for ids, _reason in b.submit(
+                    preambles[i % n_preambles] + [3 + i % 97, 7],
+                    max(8, max_new), greedy, seed=i,
+                ):
+                    out.extend(ids)
+                return len(out)
+
+            # Seed wave off the clock: every preamble sighted once
+            # (steady-state agentic shape — measured waves re-visit).
+            await _asyncio.gather(*(
+                call(1000 + p * n_preambles + p) for p in range(n_preambles)
+            ))
+            h0, m0 = batcher.prefix_hits, batcher.prefix_misses
+            t0 = time.perf_counter()
+            tokens = sum(await _asyncio.gather(
+                *(call(i) for i in range(calls))
+            ))
+            elapsed = time.perf_counter() - t0
+        finally:
+            await batcher.stop()
+        hits = batcher.prefix_hits - h0
+        misses = batcher.prefix_misses - m0
+        stats = batcher.counter_stats()
+        runs[mode] = {
+            "tokens_per_sec": tokens / elapsed,
+            "hit_rate": hits / max(1, hits + misses),
+            "kv_bytes": stats["kv_cache_bytes"],
+            "pages_in_use": stats["kv_pages_in_use"],
+            "pages_shared_now": stats["kv_pages_shared"],
+            "cow": stats["paged_cow_copies"],
+        }
+    off, on = runs["off"], runs["on"]
+    return {
+        "paged_model": model,
+        "paged_calls": calls,
+        "paged_preambles": n_preambles,
+        "paged_off_tokens_per_sec": round(off["tokens_per_sec"], 1),
+        "paged_on_tokens_per_sec": round(on["tokens_per_sec"], 1),
+        "paged_uplift_pct": round(
+            (on["tokens_per_sec"] / off["tokens_per_sec"] - 1.0) * 100.0, 1
+        ) if off["tokens_per_sec"] > 0 else 0.0,
+        "paged_off_hit_rate": round(off["hit_rate"], 4),
+        "paged_on_hit_rate": round(on["hit_rate"], 4),
+        "paged_off_kv_bytes": off["kv_bytes"],
+        "paged_on_kv_bytes": on["kv_bytes"],
+        "paged_pages_in_use": on["pages_in_use"],
+        "paged_cow_copies": on["cow"],
     }
 
 

@@ -306,14 +306,41 @@ class BatchingConfig:
     # routes each request to the smallest tier that fits it
     # (serving/tiered.py).
     kv_tiers: list = field(default_factory=list)
+    # Paged KV cache (docs/paged_kv.md): "on" replaces the contiguous
+    # per-slot rows AND the slot-granular prefix pool with one device
+    # arena of fixed-size pages per layer, per-slot block tables, and a
+    # host-side refcounted allocator (serving/pages.py) — token-level,
+    # page-aligned prefix sharing with copy-on-write at the divergent
+    # page and LRU reuse of refcount-0 pages. Greedy outputs are
+    # bit-identical to "off" (the contiguous path, kept as the provable
+    # baseline). Supersedes prefix_cache_entries (validate() rejects
+    # the combination with a clear error); mutually exclusive with
+    # kv_ring; dense-Llama, non-pipeline serving only.
+    paged_kv: str = "off"  # off | on
+    # Page granularity in tokens. Smaller pages share shorter common
+    # prefixes and waste less tail space; larger pages mean smaller
+    # tables and fewer scatter indices. Must divide kv_cache_max_seq
+    # (and every tier max_seq when tiering).
+    paged_kv_page_size: int = 16
+    # Arena size in pages. 0 = auto: max_batch_size × kv_cache_max_seq
+    # / page_size — the same KV HBM as the contiguous pool, which
+    # sharing then stretches (every shared prefix is stored once, and
+    # freed pages are exact-fit reusable instead of padded rows).
+    paged_kv_pages: int = 0
     # Prefix (prompt-KV) cache: a device-resident pool of recently seen
     # prompt prefixes; an admission whose prompt starts with a cached
     # prefix reuses its KV and prefills only the suffix — the
     # system-prompt case. 0 entries = off (serving/batching.py).
-    # NOTE: with kv_tiers, EACH tier owns an independent pool (tiers
-    # share no mutable state): HBM is tiers × entries × max_seq of KV
-    # and a prefix shared across tiers is stored once per tier. Budget
-    # entries accordingly when tiering.
+    # NOTE (slot-granular pool only — paged_kv=on replaces this pool
+    # with token-level page sharing and rejects nonzero entries): with
+    # kv_tiers, EACH tier owns an independent pool (tiers share no
+    # mutable state): HBM is tiers × entries × max_seq of KV and a
+    # prefix shared across tiers is stored once per tier. Budget
+    # entries accordingly when tiering — or turn on paged_kv, where a
+    # tier's arena stores every shared prefix exactly once at token
+    # granularity and the thrash cliff the slot pool hits when the
+    # preamble working set outgrows its entries disappears
+    # (docs/BENCH.md §"Prefix-pool thrash regime").
     prefix_cache_entries: int = 0
     prefix_cache_max_seq: int = 512  # per-entry KV capacity (tokens)
     prefix_cache_min_seq: int = 64  # don't pool prefixes shorter than this
@@ -820,6 +847,48 @@ class Config:
                     "be < the smallest tier's max_seq"
                 )
         batching = self.serving.batching
+        if batching.paged_kv not in ("off", "on"):
+            raise ValueError("batching.paged_kv must be 'off' or 'on'")
+        if batching.paged_kv_page_size < 1:
+            raise ValueError("batching.paged_kv_page_size must be >= 1")
+        if batching.paged_kv_pages < 0:
+            raise ValueError(
+                "batching.paged_kv_pages must be >= 0 (0 = auto-size)"
+            )
+        if batching.paged_kv == "on":
+            page = batching.paged_kv_page_size
+            if self.serving.kv_ring:
+                raise ValueError(
+                    "batching.paged_kv and kv_ring are mutually "
+                    "exclusive: a ring stores positions mod its "
+                    "capacity, a page table maps them — one indirection "
+                    "scheme per cache"
+                )
+            if batching.prefix_cache_entries:
+                raise ValueError(
+                    "batching.paged_kv supersedes the slot-granular "
+                    "prefix pool: set prefix_cache_entries to 0 "
+                    "(page-aligned prefix sharing is built into the "
+                    "paged allocator — docs/paged_kv.md)"
+                )
+            if batching.kv_cache_max_seq % page:
+                raise ValueError(
+                    f"batching.paged_kv_page_size ({page}) must divide "
+                    f"kv_cache_max_seq ({batching.kv_cache_max_seq}): "
+                    f"block tables map whole pages"
+                )
+            for t in tiers or []:
+                if int(t[0]) % page:
+                    raise ValueError(
+                        f"batching.paged_kv_page_size ({page}) must "
+                        f"divide every tier max_seq (tier {int(t[0])})"
+                    )
+                if len(t) > 2 and int(t[2]) > 0:
+                    raise ValueError(
+                        "batching.paged_kv supersedes per-tier prefix "
+                        "pools: kv_tiers prefix_entries must be 0 "
+                        "under paging"
+                    )
         if batching.prefix_cache_entries < 0:
             raise ValueError("prefix_cache_entries must be >= 0")
         if batching.prefix_cache_entries:

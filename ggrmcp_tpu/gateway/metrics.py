@@ -79,6 +79,13 @@ _SERVING_HELP = {
         "tokens emitted under an active grammar mask",
     "grammar_states_in_use":
         "DFA states resident in the grammar table arena",
+    "kv_pages_total": "paged KV arena size in pages",
+    "kv_pages_in_use":
+        "paged KV pages resident (live + reuse-cached)",
+    "kv_pages_shared": "paged KV pages refcount-shared by 2+ slots",
+    "paged_prefix_hits":
+        "admissions that reused shared prefix pages or a CoW source",
+    "paged_cow_copies": "divergent-page copy-on-writes",
 }
 
 _SERVING_HIST_HELP = {

@@ -227,6 +227,87 @@ def cache_specs() -> KVCache:
     return KVCache(k=spec, v=spec, length=P(("data", "fsdp")))
 
 
+class PagedKVCache(NamedTuple):
+    """Paged KV plane (batching.paged_kv, docs/paged_kv.md): one arena
+    of fixed-size pages per layer plus per-slot block tables. Positions
+    are still absolute — position j of slot b lives at
+    (table[b, j // P], j % P) — so attention semantics (RoPE, causal
+    mask, length mask) are identical to the contiguous cache; only the
+    STORAGE is indirected, which is what lets any number of slots
+    reference the pages of a shared prompt prefix. Table entries equal
+    to n_pages are the unmapped SENTINEL: gathers clip (the junk is
+    masked by `length`), scatters drop (mode="drop")."""
+
+    k: jnp.ndarray  # [L, n_pages, page, KVH, Dh] (or QuantizedArray)
+    v: jnp.ndarray
+    table: jnp.ndarray  # [B, S_max // page] int32 page ids
+    length: jnp.ndarray  # [B] int32 — valid prefix length
+
+    @classmethod
+    def create(
+        cls, cfg: LlamaConfig, batch: int, max_len: int, n_pages: int,
+        page_size: int, kv_dtype: str = "",
+    ) -> "PagedKVCache":
+        assert max_len % page_size == 0, "page_size must divide max_len"
+        width = max_len // page_size
+        shape = (
+            cfg.num_layers, n_pages, page_size, cfg.num_kv_heads,
+            cfg.head_dim,
+        )
+        dtype = cfg.jnp_dtype
+        if kv_dtype == "int8":
+            def leaf():
+                return QuantizedArray(
+                    q=jnp.zeros(shape, jnp.int8),
+                    scale=jnp.zeros(shape[:-1] + (1,), dtype),
+                )
+            k, v = leaf(), leaf()
+        elif kv_dtype:
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
+        else:
+            k, v = jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+        return cls(
+            k=k, v=v,
+            table=jnp.full((batch, width), n_pages, jnp.int32),
+            length=jnp.zeros((batch,), jnp.int32),
+        )
+
+
+def paged_cache_specs() -> PagedKVCache:
+    """Paged arena sharding: heads over tensor only — pages are shared
+    across slots, so the page axis cannot shard over a batch axis."""
+    spec = P(None, None, None, "tensor", None)
+    return PagedKVCache(k=spec, v=spec, table=P(), length=P())
+
+
+def paged_view(arena, table: jnp.ndarray):
+    """Gather a contiguous per-slot view out of a paged arena: one
+    layer's [N, P, KVH, Dh] pages + [B, W] tables → [B, W·P, KVH, Dh],
+    where view position j is absolute position j (W·P == S_max).
+    Sentinel entries clip to a real page; the junk is masked by the
+    caller's kv_len exactly like a contiguous cache's tail garbage.
+    Works on QuantizedArray arenas (values + scales gather alike)."""
+    from ggrmcp_tpu.ops.quant import kv_map
+
+    def gather(a):
+        v = a[jnp.minimum(table, a.shape[0] - 1)]  # [B, W, P, ...]
+        return v.reshape(table.shape[0], -1, *a.shape[2:])
+
+    return kv_map(gather, arena)
+
+
+def paged_view_layers(arena, table: jnp.ndarray):
+    """`paged_view` for a full [L, N, P, KVH, Dh] arena (batcher-side
+    admission gathers): → [L, B, W·P, KVH, Dh]."""
+    from ggrmcp_tpu.ops.quant import kv_map
+
+    def gather(a):
+        v = a[:, jnp.minimum(table, a.shape[1] - 1)]  # [L, B, W, P, ...]
+        return v.reshape(a.shape[0], table.shape[0], -1, *a.shape[3:])
+
+    return kv_map(gather, arena)
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -245,12 +326,24 @@ def attention_block(
     attn_impl: Optional[Any] = None,
     ring: bool = False,
     lora_idx: Optional[jnp.ndarray] = None,  # [B] adapter ids
+    page_table: Optional[jnp.ndarray] = None,  # [B, W] paged block table
 ):
     """Pre-norm GQA attention with residual; shared by the dense and MoE
     decoder families. Returns (x + attn, (cache_k, cache_v) or None).
     K/V keep their KV heads — GQA lives in ops.attention (the flash
     kernel reads shared heads in place; the XLA path contracts
     grouped for decode and repeats only for long queries).
+
+    `page_table` (paged KV, docs/paged_kv.md): cache_k/v are a page
+    ARENA [N, P, KVH, Dh] instead of per-slot rows. Writes scatter the
+    step's K/V through the table (position j → page table[b, j // P],
+    offset j % P; sentinel entries drop), reads attend a table-gathered
+    [B, W·P] view — positions, masks, and numerics are identical to the
+    contiguous cache, so paged-on/off greedy outputs are bit-identical.
+    Shared (refcounted) pages are never written: the host allocator
+    guarantees every write position ≥ the owner's prompt length lands
+    in pages it owns exclusively (serving/pages.py invariants). Paged
+    reads always take the XLA attention path.
 
     `ring=True` (sliding-window serving): the cache's sequence dim is a
     RING of capacity C — writes land at `pos % C` and attention masks
@@ -289,7 +382,54 @@ def attention_block(
     q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
-    if cache_k is not None:
+    if cache_k is not None and page_table is not None:
+        # Paged arena: scatter the step's K/V through the block table
+        # and attend a table-gathered contiguous view. Sentinel table
+        # entries (parked slots, unmapped tail) drop the write; active
+        # rows only ever write pages they own exclusively.
+        assert not ring, "paged KV does not compose with kv_ring"
+        p_sz = (
+            cache_k.q.shape[1]
+            if isinstance(cache_k, QuantizedArray) else cache_k.shape[1]
+        )
+        width = page_table.shape[1]
+        write_pos = cache_len[:, None] + jnp.arange(s)[None, :]  # [B, S]
+        w_page = jnp.take_along_axis(
+            page_table, jnp.minimum(write_pos // p_sz, width - 1), axis=1
+        )
+        w_off = write_pos % p_sz
+        if isinstance(cache_k, QuantizedArray):
+            # Int8 pages: same value+scale scatter as the contiguous
+            # int8 cache, indirected through the table.
+            qk = quantize(k, axis=-1)
+            qv = quantize(v, axis=-1)
+            cache_k = QuantizedArray(
+                q=cache_k.q.at[w_page, w_off].set(qk.q, mode="drop"),
+                scale=cache_k.scale.at[w_page, w_off].set(
+                    qk.scale.astype(cache_k.scale.dtype), mode="drop"
+                ),
+            )
+            cache_v = QuantizedArray(
+                q=cache_v.q.at[w_page, w_off].set(qv.q, mode="drop"),
+                scale=cache_v.scale.at[w_page, w_off].set(
+                    qv.scale.astype(cache_v.scale.dtype), mode="drop"
+                ),
+            )
+            k_all = dequantize(paged_view(cache_k, page_table))
+            v_all = dequantize(paged_view(cache_v, page_table))
+        else:
+            cache_k = cache_k.at[w_page, w_off].set(k, mode="drop")
+            cache_v = cache_v.at[w_page, w_off].set(v, mode="drop")
+            k_all = paged_view(cache_k, page_table)
+            v_all = paged_view(cache_v, page_table)
+        kv_len = cache_len + s
+        q_offset = cache_len
+        k_positions = None
+        k_step, v_step = k, v
+        use_flash = False  # gathered view → XLA path (flash would need
+        # a block-table-aware kernel; the dispatcher never auto-picks
+        # it here)
+    elif cache_k is not None:
         # Write new K/V at each sequence's current length, then attend
         # over the full cache prefix. Scatter via one-hot matmul-free
         # dynamic update: positions are per-batch, so use advanced
@@ -411,11 +551,12 @@ def _layer(
     attn_impl: Optional[Any] = None,
     ring: bool = False,
     lora_idx: Optional[jnp.ndarray] = None,
+    page_table: Optional[jnp.ndarray] = None,
 ):
     x, new_cache = attention_block(
         x, layer_params, cfg, positions, cache_k, cache_v, cache_len,
         use_flash=use_flash, flash_mesh=flash_mesh, attn_impl=attn_impl,
-        ring=ring, lora_idx=lora_idx,
+        ring=ring, lora_idx=lora_idx, page_table=page_table,
     )
 
     # SwiGLU MLP
@@ -449,8 +590,13 @@ def forward(
     `lora_idx`: [B] per-row adapter ids when `params["layers"]` carries
     stacked LoRA factors (ops/lora.py); None or absent factors = base.
 
+    A `PagedKVCache` (batching.paged_kv) threads through identically —
+    k/v are the page arena and the block table rides scan-invariant
+    into every layer's attention (attention_block `page_table`).
+
     Returns (logits [B, S, V], updated cache or None).
     """
+    paged = isinstance(cache, PagedKVCache)
     b, s = tokens.shape
     x = embed_lookup(params["embed"], tokens, cfg.jnp_dtype)  # [B, S, D]
 
@@ -481,11 +627,18 @@ def forward(
                 x, layer_params, cfg, positions, ck, cv, cache.length,
                 use_flash=use_flash, flash_mesh=flash_mesh,
                 attn_impl=attn_impl, ring=ring, lora_idx=lora_idx,
+                page_table=cache.table if paged else None,
             )
             return x, (ck, cv)
 
         x, (new_k, new_v) = jax.lax.scan(body, x, (layers, cache.k, cache.v))
-        new_cache = KVCache(k=new_k, v=new_v, length=cache.length + s)
+        if paged:
+            new_cache = PagedKVCache(
+                k=new_k, v=new_v, table=cache.table,
+                length=cache.length + s,
+            )
+        else:
+            new_cache = KVCache(k=new_k, v=new_v, length=cache.length + s)
 
     x = common.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["lm_head"]

@@ -42,6 +42,7 @@ from ggrmcp_tpu.ops.sampling import (
 )
 from ggrmcp_tpu.serving.engine import bucket_len, fit_request
 from ggrmcp_tpu.serving.flight_recorder import FlightRecorder
+from ggrmcp_tpu.serving.pages import PageAllocator, PageExhaustedError
 from ggrmcp_tpu.utils import failpoints
 from ggrmcp_tpu.utils.stats import pct
 
@@ -312,7 +313,48 @@ class ContinuousBatcher:
             s_max = min(self.cfg.kv_cache_max_seq, engine.cfg.max_seq_len)
             self._fit_limit = s_max
         self.max_seq = s_max
-        self.cache = engine.make_cache(b, s_max)
+        # Paged KV plane (batching.paged_kv=on, docs/paged_kv.md): the
+        # shared cache becomes one page ARENA + per-slot block tables
+        # (models/llama.py::PagedKVCache) and a host-side refcounted
+        # allocator (serving/pages.py) replaces the slot-granular
+        # prefix pool — token-level, page-aligned prefix sharing with
+        # copy-on-write at the divergent page. The contiguous path
+        # stays the off-mode so bit-identity is provable
+        # (tests/test_paged_kv.py).
+        self._paged = getattr(self.cfg, "paged_kv", "off") == "on"
+        if self._paged:
+            # config.validate mirrors these; batchers built directly in
+            # tests must hit the same walls.
+            if self._ring:
+                raise ValueError("paged_kv does not compose with kv_ring")
+            if self.cfg.prefix_cache_entries:
+                raise ValueError(
+                    "paged_kv supersedes the slot-granular prefix pool; "
+                    "set prefix_cache_entries to 0"
+                )
+            page = max(1, int(getattr(self.cfg, "paged_kv_page_size", 16)))
+            if s_max % page:
+                raise ValueError(
+                    f"paged_kv_page_size ({page}) must divide the cache "
+                    f"max_seq ({s_max})"
+                )
+            self._page_size = page
+            self._table_width = s_max // page
+            self._n_pages = (
+                int(getattr(self.cfg, "paged_kv_pages", 0) or 0)
+                or b * self._table_width
+            )
+            self.pages = PageAllocator(
+                self._n_pages, page, slots=b,
+                table_width=self._table_width,
+            )
+            self._tables_dirty = False
+            self.cache = engine.make_paged_cache(
+                b, s_max, self._n_pages, page
+            )
+        else:
+            self.pages = None
+            self.cache = engine.make_cache(b, s_max)
         # Spec mode: the draft's KV slot pool rides beside the shared
         # target cache (the cache-level merge docs/speculative.md's
         # revisit trigger asked for — one slot pool, draft cache
@@ -491,6 +533,16 @@ class ContinuousBatcher:
         self._admit_chunked_pfx = jax.jit(
             self._admit_chunked_pfx_impl, donate_argnums=(3,)
         )
+        # Paged prefix-reuse admission: gather the shared-page view
+        # into a fresh mini through a host-built gather table, run the
+        # suffix grid from the (possibly CoW-advanced) scan start, and
+        # merge only the exclusive-page positions back — ONE device
+        # call admits a whole same-prefix wave without re-prefilling a
+        # single shared page.
+        if self._paged:
+            self._admit_paged_pfx = jax.jit(
+                self._admit_paged_pfx_impl, donate_argnums=(3,)
+            )
         self._first_token = jax.jit(self._first_token_impl)
         # Prefix-pool store/load. The POOL is deliberately NOT donated:
         # stores are rare (first sighting of a prefix), entries are
@@ -550,6 +602,70 @@ class ContinuousBatcher:
         """Admission mini cache matching the engine's KV storage."""
         return llama_mod.KVCache.create(
             self.engine.cfg, rows, length, self.engine.kv_dtype
+        )
+
+    def _make_shared_cache(self):
+        """Fresh shared cache of this batcher's configured shape — the
+        initial build and every tick-failure rebuild go through here so
+        the paged and contiguous planes can't drift."""
+        if self._paged:
+            return self.engine.make_paged_cache(
+                len(self.slots), self.max_seq, self._n_pages,
+                self._page_size,
+            )
+        return self.engine.make_cache(len(self.slots), self.max_seq)
+
+    # -- paged KV host/device glue (batching.paged_kv=on) -------------------
+
+    def _sync_tables(self) -> None:
+        """Upload the host block tables when they changed since the
+        last device call. The tables are HOST state (serving/pages.py
+        owns them); the device only ever sees snapshots — admissions
+        map pages, finishes unmap them, and the next dispatch carries
+        the new mapping. Replay after a tick failure re-MAPS this way
+        too: the allocator state is rebuilt host-side and re-uploaded,
+        never re-derived from device buffers."""
+        if self._paged and self._tables_dirty:
+            self.cache = self.cache._replace(
+                table=jnp.asarray(self.pages.tables)
+            )
+            self._tables_dirty = False
+
+    def _paged_put(self, cache, mini, slots, true_len, start):
+        """Paged counterpart of every row merge (_merge_row, the
+        full-pool select, the chunked-finish scatter): write mini rows'
+        positions [start_r, true_len_r) through slots' block tables
+        into the arena. `start` masks off SHARED prefix pages — those
+        are immutable, refcounted storage; only the row's exclusive
+        pages are written, and only the positions the row actually
+        holds (no more full-row copies). Padding rows (slot index out
+        of range) and sentinel table entries drop."""
+        b = len(self.slots)
+        p = self._page_size
+        r = true_len.shape[0]
+        mk = mini.k.q if isinstance(mini.k, quant.QuantizedArray) else mini.k
+        s = mk.shape[2]
+        pos = jnp.arange(s)
+        rows = jnp.clip(slots, 0, b - 1)
+        rtab = cache.table[rows]  # [R, W]
+        page = rtab[:, jnp.minimum(pos // p, self._table_width - 1)]
+        off = jnp.broadcast_to(pos % p, (r, s))
+        start = jnp.broadcast_to(start, (r,))
+        valid = (
+            (pos[None, :] >= start[:, None])
+            & (pos[None, :] < true_len[:, None])
+            & (slots[:, None] >= 0) & (slots[:, None] < b)
+        )
+        page = jnp.where(valid, page, self._n_pages)
+
+        def put(a, m):
+            return a.at[:, page, off].set(m.astype(a.dtype), mode="drop")
+
+        k = quant.kv_map(put, cache.k, mini.k)
+        v = quant.kv_map(put, cache.v, mini.v)
+        length = cache.length.at[slots].set(true_len, mode="drop")
+        return llama_mod.PagedKVCache(
+            k=k, v=v, table=cache.table, length=length
         )
 
     # -- grammar host side (serving/batching owns residency + states) -------
@@ -624,6 +740,11 @@ class ContinuousBatcher:
             params, tokens, true_len, seeds, temps, ks, ps, adapters,
             g0, g_allow, g_trans,
         )
+        if self._paged:
+            return first, self._paged_put(
+                cache, mini, jnp.reshape(slot, (1,)), true_len,
+                jnp.int32(0),
+            )
         return first, _merge_row(cache, mini, slot, true_len[0])
 
     def _admit_full_impl(
@@ -639,6 +760,13 @@ class ContinuousBatcher:
             params, tokens, true_len, seeds, temps, ks, ps, adapters,
             g0, g_allow, g_trans,
         )
+        if self._paged:
+            slots = jnp.where(
+                valid, jnp.arange(len(self.slots)), len(self.slots)
+            )
+            return first, self._paged_put(
+                cache, mini, slots, true_len, jnp.int32(0)
+            )
         sel = valid[None, :, None, None, None]
 
         def select(c, m):
@@ -690,15 +818,23 @@ class ContinuousBatcher:
 
     def _chunked_finish(
         self, cache, mini, slots, true_len, fl, seeds, temps, ks, ps,
-        g0, g_allow, g_trans,
+        g0, g_allow, g_trans, start=None,
     ):
         """Scatter the [R, S_max] admission mini into the shared cache
         at `slots` (padding rows carry an out-of-range slot index and
         are DROPPED by the scatter — real slots are distinct, so no
-        duplicate-index hazards) and sample each row's first token."""
+        duplicate-index hazards) and sample each row's first token.
+        Paged mode routes through _paged_put instead, writing only
+        [start, true_len) of each row (start > 0 = the paged-pfx
+        admission's shared-page boundary)."""
         first, _ = masked_sample_dynamic(
             fl, seeds, jnp.int32(0), temps, ks, ps, g0, g_allow, g_trans
         )
+        if self._paged:
+            return first, self._paged_put(
+                cache, mini, slots, true_len,
+                jnp.int32(0) if start is None else start,
+            )
 
         def put(c_, m):
             return c_.at[:, slots].set(m.astype(c_.dtype), mode="drop")
@@ -758,6 +894,36 @@ class ContinuousBatcher:
         return self._chunked_finish(
             cache, mini, slots, true_len, fl, seeds, temps, ks, ps,
             g0, g_allow, g_trans,
+        )
+
+    def _admit_paged_pfx_impl(
+        self, params, tokens, true_len, cache, slots, gtables,
+        scan_start, merge_start, seeds, temps, ks, ps, adapters,
+        g0, g_allow, g_trans,
+    ):
+        """Fused paged prefix-reuse admission: gather each row's shared
+        prefix into a full-width mini VIEW through the host-built
+        gather tables (`gtables` = the slot's block-table row, with the
+        first divergent entry swapped for the copy-on-write source page
+        when one matched), run the [R, T, C] suffix grid from
+        `scan_start`, and merge positions [merge_start, n) back into
+        the rows' OWN exclusive pages. Shared pages are read, never
+        written; scan_start > merge_start is the CoW case — the overlap
+        tokens' KV rides the gather and the merge copies it into the
+        slot's fresh divergent page instead of recomputing it. One
+        device call admits a whole same-preamble wave."""
+        r = tokens.shape[0]
+        mini = llama_mod.KVCache(
+            k=llama_mod.paged_view_layers(cache.k, gtables),
+            v=llama_mod.paged_view_layers(cache.v, gtables),
+            length=jnp.broadcast_to(scan_start, (r,)).astype(jnp.int32),
+        )
+        fl, mini = self._chunked_scan(
+            params, tokens, true_len, mini, adapters, scan_start
+        )
+        return self._chunked_finish(
+            cache, mini, slots, true_len, fl, seeds, temps, ks, ps,
+            g0, g_allow, g_trans, start=merge_start,
         )
 
     def _decode_scan(
@@ -954,7 +1120,13 @@ class ContinuousBatcher:
             v=quant.kv_map(pick, mini.v),
             length=jnp.full((1,), n, jnp.int32),
         )
-        cache = _merge_row(cache, picked, slot, n)
+        if self._paged:
+            cache = self._paged_put(
+                cache, picked, jnp.reshape(slot, (1,)),
+                jnp.reshape(n, (1,)), jnp.int32(0),
+            )
+        else:
+            cache = _merge_row(cache, picked, slot, n)
         fl = jax.lax.dynamic_slice_in_dim(sel, row, 1, axis=0)
         first, _ = masked_sample_dynamic(
             fl, seeds, jnp.int32(0), temps, ks, ps, g0, g_allow, g_trans
@@ -1330,6 +1502,15 @@ class ContinuousBatcher:
         self.top_ps[slot_idx] = request.sampling.top_p
         self.seeds[slot_idx] = request.seed & 0xFFFFFFFF
         self.adapter_ids[slot_idx] = request.adapter
+        # Paged KV: the prompt's full pages now hold valid prefix KV
+        # (activation implies the prefill materialized) — index them so
+        # later admissions share instead of recomputing. BASE rows only:
+        # adapter'd K/V must never enter shared storage (same rule as
+        # the slot-granular pool). Before _emit: a one-token request
+        # finishes inside it, and the cache window should survive the
+        # request (refcount-0 indexed pages stay resident, LRU-evicted).
+        if self._paged and request.adapter == 0:
+            self.pages.register(slot_idx, request.prompt)
         self._emit(slot_idx, first_tok)
 
     # -- public API ---------------------------------------------------------
@@ -1507,6 +1688,36 @@ class ContinuousBatcher:
                 jnp.asarray(zf1), jnp.asarray(zi1), jnp.asarray(of1),
                 jnp.asarray(zi1), g_allow, g_trans,
             )
+        if self._paged:
+            # Paged prefix-reuse admission ladder: every suffix-width
+            # bucket a page hit can pick, trickle (R=1) and wave (R=B)
+            # row shapes — the same no-cold-compile-mid-request policy
+            # as the pool ladder below. All-sentinel gather tables and
+            # out-of-range slots keep it inert (reads clip to junk that
+            # is never merged; merges drop). Deeper [R, T>1, C] suffix
+            # grids compile on their first long shared prompt, exactly
+            # like the cold chunked grids.
+            width = 32
+            while width <= bucket_len(c, maximum=self.max_seq):
+                for r_rows in (1, b_rows) if b_rows > 1 else (1,):
+                    gtw = np.full(
+                        (r_rows, self._table_width), self._n_pages,
+                        np.int32,
+                    )
+                    _, self.cache = self._admit_paged_pfx(
+                        self.engine.params,
+                        jnp.asarray(np.zeros((r_rows, 1, width), np.int32)),
+                        jnp.asarray(zlenb[:r_rows]), self.cache,
+                        jnp.asarray(zslotb[:r_rows]), jnp.asarray(gtw),
+                        jnp.int32(0), jnp.int32(0),
+                        jnp.asarray(zseedb[:r_rows]),
+                        jnp.asarray(zfb[:r_rows]),
+                        jnp.asarray(zib[:r_rows]),
+                        jnp.asarray(ofb[:r_rows]),
+                        jnp.asarray(zib[:r_rows]),
+                        jnp.asarray(zib[:r_rows]), g_allow, g_trans,
+                    )
+                width *= 2
         if self._pfx_pool is not None:
             # plen=0 and no host-side key: the warmup entry can never
             # match a lookup. Store programs first (mini from a plain
@@ -1701,9 +1912,12 @@ class ContinuousBatcher:
             request.cancelled = True
 
     def cache_bytes(self) -> int:
-        """KV-cache HBM: the shared slot pool, the prefix pool, and
-        the interleave mini cache (K admission rows) once allocated."""
+        """KV-cache HBM: the shared slot pool (or paged arena + block
+        tables), the prefix pool, and the interleave mini cache (K
+        admission rows) once allocated."""
         total = self.cache.k.nbytes + self.cache.v.nbytes
+        if self._paged:
+            total += self.cache.table.nbytes
         if self._pfx_pool is not None:
             total += self._pfx_pool.k.nbytes + self._pfx_pool.v.nbytes
         if self._ilv_mini is not None:
@@ -1806,6 +2020,16 @@ class ContinuousBatcher:
             "shed_requests": self.shed,
             "replayed_requests": self.replayed,
             "replay_exhausted": self.replay_exhausted,
+            # Paged KV plane (batching.paged_kv=on; all 0 when off):
+            # arena occupancy gauges plus the sharing counters — pages
+            # resident (live + reuse cache), pages referenced by 2+
+            # slots right now, admissions that reused shared pages or a
+            # CoW source, and divergent-page copy-on-writes.
+            **(self.pages.stats() if self._paged else {
+                "kv_pages_total": 0, "kv_pages_in_use": 0,
+                "kv_pages_shared": 0, "paged_prefix_hits": 0,
+                "paged_cow_copies": 0,
+            }),
             # Interleaved (tick-fused) admission activity: chunks
             # piggybacked onto decode ticks / requests admitted that way.
             "interleaved_chunks": self.interleaved_chunks,
@@ -2002,9 +2226,16 @@ class ContinuousBatcher:
         self.adapter_ids[:] = 0
         self.gstates[:] = 0
         self._gstate_dev = None
-        self.cache = self.engine.make_cache(
-            len(self.slots), self.max_seq
-        )
+        if self._paged:
+            # The donated arena died with the tick: every page and
+            # every index entry is device-dead. Reset the HOST
+            # allocator wholesale — victims replay through admission,
+            # which re-maps fresh pages and re-registers prefixes (a
+            # shared preamble re-shares from its first replayed
+            # sighting; hit rate dips for one wave, correctness never).
+            self.pages.reset()
+            self._tables_dirty = True
+        self.cache = self._make_shared_cache()
         if self._spec:
             # The spec tick donated the draft pool alongside the shared
             # cache; every victim replays through admission, which
@@ -2145,6 +2376,16 @@ class ContinuousBatcher:
                         self._loop_ref.call_soon_threadsafe(
                             request.out.put_nowait, ([], "error")
                         )
+                if self._paged and not cache_dead:
+                    # The arena survived (the failing call didn't
+                    # donate it), but the failed rows' block tables
+                    # must not leak their pages — and their eagerly
+                    # indexed, never-prefilled pages must leave the
+                    # index rather than cache garbage.
+                    for sl, request in zip(slots_idx, batch):
+                        if id(request) not in activated:
+                            self.pages.free_slot(sl, discard_index=True)
+                            self._tables_dirty = True
                 if cache_dead:
                     # The donated buffers are dead: every active slot's
                     # KV rows go with them (anything less would stream
@@ -2160,9 +2401,10 @@ class ContinuousBatcher:
                         slot.request = None
                         slot.done = False
                     self._slot_last_emit = [None] * len(self.slots)
-                    self.cache = self.engine.make_cache(
-                        len(self.slots), self.max_seq
-                    )
+                    if self._paged:
+                        self.pages.reset()
+                        self._tables_dirty = True
+                    self.cache = self._make_shared_cache()
                     self._cache_at_risk = False
                 continue
             admitted += len(batch)
@@ -2237,7 +2479,77 @@ class ContinuousBatcher:
             self._active_count() > 0 or self._ilv_busy()
         )
         trickle = len(batch) == 1
-        for sl, req in zip(slots_idx, batch):
+        # Paged pre-pass (batching.paged_kv=on): every row gets its
+        # block table built FIRST — the longest page-aligned indexed
+        # prefix is refcount-shared, a divergent-page CoW source is
+        # picked, and exclusive pages cover the rest of the request's
+        # lifetime (prompt + max_new + tick overshoot: no allocation
+        # ever happens inside jit). Rows with any reuse group by suffix
+        # geometry into fused _admit_paged_pfx calls; cold rows fall
+        # through to the unchanged fused/chunked/interleaved routing
+        # (whose merges write pages via _paged_put).
+        paged_groups: dict[tuple, list] = {}
+        rows = list(zip(slots_idx, batch))
+        shed_rows = 0
+        if self._paged:
+            c = min(self.cfg.prefill_chunk, self.max_seq)
+            cold: list[tuple[int, _Request]] = []
+            for sl, req in rows:
+                try:
+                    # Chaos hook: page_exhausted forces the allocator's
+                    # exhaustion path (utils/failpoints.py).
+                    failpoints.evaluate("page_exhausted")
+                    adm = self.pages.admit(
+                        sl, req.prompt,
+                        len(req.prompt) + req.max_new + self._reserve + 1,
+                        share=req.adapter == 0,
+                    )
+                except (PageExhaustedError, failpoints.FailpointError):
+                    # Typed shed on the PR-2 overload ladder: the
+                    # "overloaded" terminal maps to RESOURCE_EXHAUSTED
+                    # at the sidecar and HTTP 429 + Retry-After at the
+                    # gateway. admit() is all-or-nothing, so resident
+                    # block tables are untouched.
+                    self.shed += 1
+                    shed_rows += 1
+                    self._record_terminal(req, "overloaded")
+                    self._loop_ref.call_soon_threadsafe(
+                        req.out.put_nowait, ([], "overloaded")
+                    )
+                    continue
+                self._tables_dirty = True
+                if adm.scan_start > 0:
+                    if req.adapter == 0:
+                        self.prefix_hits += 1
+                    suffix = len(req.prompt) - adm.scan_start
+                    if suffix <= c:
+                        t_steps = 1
+                        width = bucket_len(suffix, maximum=self.max_seq)
+                    else:
+                        t_steps, width = -(-suffix // c), c
+                    key = (adm.merge_start, adm.scan_start, t_steps, width)
+                    paged_groups.setdefault(key, []).append((sl, req, adm))
+                else:
+                    if req.adapter == 0:
+                        self.prefix_misses += 1
+                    cold.append((sl, req))
+                    # Eager registration (the burst shape the old pool
+                    # served with _pfx_learn_from_burst): index this
+                    # cold row's full pages NOW, so same-round rows
+                    # sharing its preamble land in a paged group
+                    # instead of recomputing it. Sound because cold
+                    # fused/chunked calls dispatch BEFORE the paged
+                    # groups below (device order writes the pages
+                    # before any gather reads them) — which is why
+                    # interleave-bound rows (prefilled across FUTURE
+                    # ticks) must not register early, and an admission
+                    # failure deregisters (free_slot discard_index).
+                    if req.adapter == 0 and not (
+                        ilv and len(req.prompt) > self.cfg.prefill_chunk
+                    ):
+                        self.pages.register(sl, req.prompt)
+            rows = cold
+        for sl, req in rows:
             # The prefix pool holds BASE-model KV only: a pooled prefix
             # computed under one adapter would silently seed a
             # different adapter's (or the base model's) request with
@@ -2276,10 +2588,15 @@ class ContinuousBatcher:
                 fused_batch.append(req)
         if long_rows:
             self._admit_chunked_group(long_rows)
-        for (entry, start, width), rows in pfx_groups.items():
-            self._admit_chunked_group(rows, pfx=(entry, start, width))
+        for (entry, start, width), group in pfx_groups.items():
+            self._admit_chunked_group(group, pfx=(entry, start, width))
         if fused_batch:
             self._prefill_fused(fused_slots, fused_batch)
+        # Paged groups LAST: a group may gather pages a cold call above
+        # just wrote (eager same-round registration) — device execution
+        # follows dispatch order, so the writes land first.
+        for key, group in paged_groups.items():
+            self._admit_paged_group(group, *key)
         if self._spec:
             # Draft-side admission for every slot this round activated
             # (fused, chunked, and prefix paths alike; interleave-queued
@@ -2311,7 +2628,7 @@ class ContinuousBatcher:
         # ~zero cost into the EMA would let the p50_budget_ms cap admit
         # unbounded short-prompt bursts on the strength of cheap
         # enqueues.
-        prefilled = len(batch) - queued
+        prefilled = len(batch) - queued - shed_rows
         if prefilled:
             self._admit_ema_ms = (
                 0.7 * self._admit_ema_ms + 0.3 * dt / prefilled
@@ -2370,6 +2687,7 @@ class ContinuousBatcher:
         if pfx is not None:
             self.prefix_hits += len(rows)
         g_allow, g_trans = self._grammar_tables()
+        self._sync_tables()
         self._cache_at_risk = True
         if pfx is None:
             first, self.cache = self._admit_chunked(
@@ -2393,6 +2711,61 @@ class ContinuousBatcher:
         first = np.asarray(first)
         self._cache_at_risk = False
         for j, (sl, req) in enumerate(rows):
+            self._activate_slot(sl, req, int(first[j]))
+
+    def _admit_paged_group(
+        self,
+        rows: list[tuple[int, _Request, object]],
+        merge_start: int,
+        scan_start: int,
+        t_steps: int,
+        width: int,
+    ) -> None:
+        """ONE fused device call admitting a group of paged prefix
+        reuses that share suffix geometry (same merge/scan starts and
+        [T, C] suffix grid — a same-preamble wave lands in one group,
+        the agentic arrival shape the old pool served with
+        _admit_chunked_pfx). Row-count bucketing mirrors
+        _admit_chunked_group; padding rows carry slot index B and an
+        all-sentinel gather table (reads clip, writes drop)."""
+        b = len(self.slots)
+        r = min(b, bucket_len(len(rows), minimum=1))
+        tokens = np.zeros((r, t_steps, width), np.int32)
+        true_len = np.zeros((r,), np.int32)
+        slots_arr = np.full((r,), b, np.int32)
+        gtables = np.full((r, self._table_width), self._n_pages, np.int32)
+        seeds = np.zeros((r,), np.uint32)
+        temps = np.zeros((r,), np.float32)
+        ks = np.zeros((r,), np.int32)
+        ps = np.ones((r,), np.float32)
+        adapters = np.zeros((r,), np.int32)
+        g0s = np.zeros((r,), np.int32)
+        for j, (sl, req, adm) in enumerate(rows):
+            piece = np.asarray(req.prompt[scan_start:], np.int32)
+            tokens[j].reshape(-1)[: len(piece)] = piece
+            true_len[j] = len(req.prompt)
+            slots_arr[j] = sl
+            gtables[j] = adm.gather_row
+            seeds[j] = req.seed & 0xFFFFFFFF
+            temps[j] = req.sampling.temperature
+            ks[j] = req.sampling.top_k
+            ps[j] = req.sampling.top_p
+            adapters[j] = req.adapter
+            g0s[j] = self._g0(req)
+        g_allow, g_trans = self._grammar_tables()
+        self._sync_tables()
+        self._cache_at_risk = True
+        first, self.cache = self._admit_paged_pfx(
+            self.engine.params, jnp.asarray(tokens),
+            jnp.asarray(true_len), self.cache, jnp.asarray(slots_arr),
+            jnp.asarray(gtables), jnp.int32(scan_start),
+            jnp.int32(merge_start), jnp.asarray(seeds),
+            jnp.asarray(temps), jnp.asarray(ks), jnp.asarray(ps),
+            jnp.asarray(adapters), jnp.asarray(g0s), g_allow, g_trans,
+        )
+        first = np.asarray(first)
+        self._cache_at_risk = False
+        for j, (sl, req, adm) in enumerate(rows):
             self._activate_slot(sl, req, int(first[j]))
 
     def _prefill_fused(
@@ -2437,6 +2810,7 @@ class ContinuousBatcher:
             adapters[row] = req.adapter
             g0s[row] = self._g0(req)
         g_allow, g_trans = self._grammar_tables()
+        self._sync_tables()
         self._cache_at_risk = True
         if single:
             first, self.cache = self._admit_single(
@@ -2508,9 +2882,11 @@ class ContinuousBatcher:
             shed=self.shed,
             replayed=self.replayed,
             timed_out=self.timed_out,
+            kv_pages_in_use=self.pages.in_use() if self._paged else 0,
         )
 
     def _tick_dispatch(self) -> None:
+        self._sync_tables()
         t0 = time.perf_counter()
         step0 = self.step_counter
         self.step_counter += self._steps_per_tick
@@ -2558,6 +2934,7 @@ class ContinuousBatcher:
         slot by its accepted count."""
         if chunk:
             self._ilv_fill_rows()
+        self._sync_tables()
         t0 = time.perf_counter()
         step0 = self.step_counter
         # gamma+1 target positions per round — decode_steps counts
@@ -2667,6 +3044,7 @@ class ContinuousBatcher:
         sample + activation — one small device call each, once per
         admission)."""
         self._ilv_fill_rows()
+        self._sync_tables()
         t0 = time.perf_counter()
         step0 = self.step_counter
         self.step_counter += self._steps_per_tick
@@ -2711,6 +3089,7 @@ class ContinuousBatcher:
         st = self._ilv_rows[r]
         req = st.request
         g_allow, g_trans = self._grammar_tables()
+        self._sync_tables()
         first, self.cache = self._ilv_finish(
             self.cache, self._ilv_mini, jnp.int32(r), jnp.int32(st.slot),
             jnp.int32(st.n), sel,
@@ -2840,6 +3219,15 @@ class ContinuousBatcher:
             self.temps[slot_idx] = 0.0
             self.adapter_ids[slot_idx] = 0
             self.gstates[slot_idx] = 0
+            if self._paged:
+                # Release the slot's page references (indexed pages
+                # stay resident as evictable reuse cache) and unmap the
+                # row to the sentinel — an in-flight pipelined tick's
+                # junk writes against the stale device table land only
+                # in this slot's own former tail pages, which every
+                # reuser fully re-prefills before reading.
+                self.pages.free_slot(slot_idx)
+                self._tables_dirty = True
         # Every delivered token also lands in `acc`: for unary
         # consumers it is the terminal payload; for ALL consumers it
         # is the replay prefix a tick failure resumes from.

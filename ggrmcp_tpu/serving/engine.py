@@ -808,6 +808,54 @@ class GenerationEngine:
                 ),
             )()
 
+    def make_paged_cache(
+        self, batch: int, max_len: int, n_pages: int, page_size: int
+    ) -> llama_mod.PagedKVCache:
+        """Mesh-sharded paged KV arena + block tables (batching.paged_kv,
+        docs/paged_kv.md). Pages shard heads over `tensor` only — a page
+        is shared across slots, so the page axis cannot ride a batch
+        axis. Dense-Llama, non-PP serving only (the batcher validates;
+        the staged forward doesn't thread block tables)."""
+        if self.pp_serving:
+            raise ValueError(
+                "paged_kv does not compose with pipeline-parallel "
+                "serving (the staged forward has no block-table path)"
+            )
+        if self.fam is not llama_mod:
+            raise ValueError("paged_kv supports dense Llama only")
+        kv_shape = (
+            self.cfg.num_layers, n_pages, page_size,
+            self.cfg.num_kv_heads, self.cfg.head_dim,
+        )
+        scale_shape = kv_shape[:-1] + (1,)
+        raw = llama_mod.paged_cache_specs()
+
+        def kv_spec(spec):
+            adapted = mesh_mod.compatible_spec(spec, kv_shape, self.mesh)
+            if not self.kv_dtype:
+                return adapted
+            return quant.QuantizedArray(
+                q=adapted,
+                scale=mesh_mod.compatible_spec(
+                    spec, scale_shape, self.mesh
+                ),
+            )
+
+        specs = llama_mod.PagedKVCache(
+            k=kv_spec(raw.k), v=kv_spec(raw.v),
+            table=raw.table, length=raw.length,
+        )
+        with self.mesh:
+            return jax.jit(
+                partial(
+                    llama_mod.PagedKVCache.create, self.cfg, batch,
+                    max_len, n_pages, page_size, self.kv_dtype,
+                ),
+                out_shardings=jax.tree_util.tree_map(
+                    lambda s: NamedSharding(self.mesh, s), specs,
+                ),
+            )()
+
     def _pack_prompts(
         self, prompts: list[list[int]], max_new: int, limit: int
     ) -> tuple[np.ndarray, np.ndarray, int]:

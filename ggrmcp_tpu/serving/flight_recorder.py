@@ -64,6 +64,10 @@ class TickRecord:
     # plain ticks). Completed at collect, like finished/duration_ms.
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # Paged KV arena occupancy at dispatch (batching.paged_kv=on; 0
+    # off): resident pages — live + reuse-cached — so a tick window
+    # shows page pressure next to its admissions/finishes.
+    kv_pages_in_use: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -81,6 +85,7 @@ class TickRecord:
             "source": self.source,
             "specDrafted": self.spec_drafted,
             "specAccepted": self.spec_accepted,
+            "kvPagesInUse": self.kv_pages_in_use,
         }
 
 
@@ -181,6 +186,7 @@ class FlightRecorder:
         shed: int,
         replayed: int,
         timed_out: int,
+        kv_pages_in_use: int = 0,
     ) -> Optional[TickRecord]:
         """Record a tick at dispatch; returns the record so the caller
         can carry it alongside the in-flight device call and complete
@@ -199,6 +205,7 @@ class FlightRecorder:
             timed_out_total=timed_out,
             trace_ids=trace_ids,
             source=self.source,
+            kv_pages_in_use=kv_pages_in_use,
         )
         self._admitted_since_tick = 0
         self._ticks.append(rec)

@@ -1,0 +1,327 @@
+"""Host-side page allocator for the paged KV cache (batching.paged_kv).
+
+The KV plane's storage manager: the device holds ONE fixed-shape arena
+of `[n_pages, page_size, kv_heads, head_dim]` K/V pages per layer
+(models/llama.py::PagedKVCache) and every decode slot owns a
+`[S_max / page_size]` int32 block-table row mapping its logical token
+positions onto arena pages. This module owns everything about that
+mapping that is HOST state — which it all is, by design: refcounts,
+the free list, the token-content prefix index, LRU eviction stamps, and
+the block tables themselves (the batcher uploads a table snapshot
+before each device call; the device never allocates).
+
+vLLM's PagedAttention supplies the arena/block-table storage model;
+SGLang's radix-tree prefix matching supplies the lookup discipline —
+realized here as a hash CHAIN over page contents: page j of a prompt is
+keyed by hash(key_{j-1}, tokens_j), so the longest page-aligned shared
+prefix is found by walking children from the root in O(matched pages),
+and any number of requests whose prompts share those pages hold
+refcounts on the SAME physical pages (admitted once, stored once).
+Copy-on-write happens at the first divergent page: if an indexed page
+extends the matched chain and agrees with the request's next tokens for
+t > 0 positions, its KV is gathered into the admission mini alongside
+the shared prefix and re-merged into the request's own fresh page — one
+page-sized device copy instead of recomputing up to page_size - 1
+positions (the `paged_cow_copies` counter).
+
+Invariants the device side relies on (serving/batching.py):
+  * A page referenced by 2+ slots (or indexed for reuse) is IMMUTABLE:
+    admission merges skip positions below the shared boundary and
+    decode writes land at positions >= the owner's prompt length, which
+    is always inside the owner's exclusive tail pages.
+  * Only full pages whose every position is covered by a successfully
+    prefilled prompt enter the index — indexed KV is always valid.
+  * A parked slot's table row is reset to the out-of-range SENTINEL
+    (= n_pages): in-flight device writes against a stale table row are
+    scatter-dropped, never corruption.
+
+Threading: every method runs inside the owning batcher's serialized
+executor calls (docs/threading.md — batcher-owned host state, exactly
+like the old prefix-pool maps this module replaces).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import numpy as np
+
+logger = logging.getLogger("ggrmcp.serving.pages")
+
+_ROOT = 0  # chain key of the empty prefix
+
+
+class PageExhaustedError(RuntimeError):
+    """The arena cannot supply the pages an admission needs even after
+    evicting every reusable (refcount-0) cached page. The batcher sheds
+    the request typed — RESOURCE_EXHAUSTED at the sidecar, HTTP 429 +
+    Retry-After at the gateway (the PR-2 overload ladder) — and resident
+    block tables are untouched (admit() is all-or-nothing)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PageAdmission:
+    """One admission's placement decision.
+
+    merge_start: first position the suffix prefill must WRITE into the
+        slot's pages (= shared full pages × page_size; everything below
+        is shared, immutable storage).
+    scan_start: first position the suffix prefill must COMPUTE —
+        merge_start, plus the copy-on-write overlap when a cached
+        divergent page supplied the first `scan_start - merge_start`
+        positions' KV (those ride the gather and are re-merged into the
+        slot's own page).
+    gather_row: [table_width] int32 block-table row the admission
+        program GATHERS the prefix view through — the slot's real row,
+        except the first divergent entry points at the CoW source page.
+    pages_shared: full prefix pages reused (refcounted, not copied).
+    """
+
+    merge_start: int
+    scan_start: int
+    gather_row: np.ndarray
+    pages_shared: int
+
+
+class PageAllocator:
+    """Refcounted page allocator + token-level prefix index for ONE
+    batcher's paged KV arena."""
+
+    def __init__(self, n_pages: int, page_size: int, slots: int,
+                 table_width: int):
+        if n_pages < 1 or page_size < 1:
+            raise ValueError("n_pages and page_size must be >= 1")
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.width = table_width
+        self.sentinel = n_pages  # out-of-range: gather clips, scatter drops
+        # [B, W] block tables — THE host-authoritative mapping; the
+        # batcher snapshots it to the device when marked dirty.
+        self.tables = np.full((slots, table_width), self.sentinel, np.int32)
+        self._ref = np.zeros(n_pages, np.int64)
+        self._free: list[int] = list(range(n_pages))
+        # Prefix index: chain key -> page, plus per-page content and
+        # chain linkage for verification, CoW probing, and eviction.
+        self._index: dict[int, int] = {}
+        self._key_of: dict[int, int] = {}
+        self._tokens_of: dict[int, np.ndarray] = {}
+        self._parent_of: dict[int, int] = {}
+        self._children: dict[int, set[int]] = {}
+        # LRU stamps for refcount-0 indexed pages (the evictable set).
+        self._stamp: dict[int, int] = {}
+        self._clock = 0
+        # Counters (ServingStats): admissions that reused shared pages
+        # or a CoW source / that found nothing; cumulative pages
+        # reference-shared instead of recomputed; divergent-page copies.
+        self.hits = 0
+        self.misses = 0
+        self.pages_reused = 0
+        self.cow_copies = 0
+
+    # -- stats ---------------------------------------------------------------
+
+    def in_use(self) -> int:
+        """Arena pages resident (live + cached-for-reuse) — the HBM
+        occupancy gauge."""
+        return self.n_pages - len(self._free)
+
+    def shared(self) -> int:
+        """Pages currently referenced by 2+ slots."""
+        return int((self._ref >= 2).sum())
+
+    def stats(self) -> dict:
+        return {
+            "kv_pages_total": self.n_pages,
+            "kv_pages_in_use": self.in_use(),
+            "kv_pages_shared": self.shared(),
+            "paged_prefix_hits": self.hits,
+            "paged_cow_copies": self.cow_copies,
+        }
+
+    # -- prefix index --------------------------------------------------------
+
+    @staticmethod
+    def _chain(parent: int, tokens: np.ndarray) -> int:
+        return hash((parent, tokens.tobytes()))
+
+    def _lookup(self, arr: np.ndarray, limit: int) -> tuple[list, int, int, int]:
+        """Longest page-aligned indexed prefix of arr[:limit] plus the
+        best partially matching divergent page. Returns (shared pages,
+        chain key at the divergence, cow_page or -1, cow_overlap)."""
+        p = self.page_size
+        key = _ROOT
+        pages: list[int] = []
+        for j in range(limit // p):
+            toks = arr[j * p:(j + 1) * p]
+            nxt = self._chain(key, toks)
+            page = self._index.get(nxt)
+            if page is None or not np.array_equal(self._tokens_of[page], toks):
+                break  # hash collision verifies as a miss
+            pages.append(page)
+            key = nxt
+        m = len(pages)
+        rem = arr[m * p: min(limit, (m + 1) * p)]
+        cow_page, cow_t = -1, 0
+        for page in self._children.get(key, ()):
+            cached = self._tokens_of[page]
+            n = min(len(cached), len(rem))
+            neq = np.nonzero(cached[:n] != rem[:n])[0]
+            t = int(neq[0]) if neq.size else n
+            if t > cow_t:
+                cow_page, cow_t = page, t
+        return pages, key, cow_page, cow_t
+
+    def _unindex(self, page: int) -> None:
+        key = self._key_of.pop(page)
+        self._index.pop(key, None)
+        self._children.pop(key, None)  # orphan subtree: verification
+        # against _tokens_of keeps any dangling child unreachable, and
+        # those children are themselves evictable entries.
+        parent = self._parent_of.pop(page)
+        kids = self._children.get(parent)
+        if kids is not None:
+            kids.discard(page)
+            if not kids:
+                self._children.pop(parent, None)
+        self._tokens_of.pop(page, None)
+
+    def _reclaim(self, need: int) -> None:
+        """Evict refcount-0 indexed pages, LRU first, until `need`
+        pages are free. All-or-nothing: raises before mutating anything
+        if the evictable set cannot cover the shortfall."""
+        shortfall = need - len(self._free)
+        if shortfall <= 0:
+            return
+        if shortfall > len(self._stamp):
+            raise PageExhaustedError(
+                f"page pool exhausted: need {need} pages, "
+                f"{len(self._free)} free + {len(self._stamp)} evictable "
+                f"of {self.n_pages}"
+            )
+        victims = sorted(self._stamp, key=self._stamp.__getitem__)[:shortfall]
+        for page in victims:
+            del self._stamp[page]
+            self._unindex(page)
+            self._free.append(page)
+
+    # -- slot lifecycle ------------------------------------------------------
+
+    def admit(self, slot: int, prompt: list, need_len: int,
+              share: bool = True) -> PageAdmission:
+        """Build slot's block table for a request that will occupy
+        positions [0, need_len): reuse the longest page-aligned indexed
+        prefix (refcounted), pick a CoW source for the divergent page,
+        allocate fresh exclusive pages for the rest. All-or-nothing —
+        PageExhaustedError leaves every resident table untouched.
+        `share=False` (LoRA-adapter rows) allocates fully exclusive and
+        consults nothing: adapter'd K/V must never alias base-model
+        pages (the same contamination rule the slot-granular pool
+        enforced)."""
+        self.free_slot(slot)  # defensive: admit implies a parked row
+        p = self.page_size
+        w_need = -(-need_len // p)
+        if w_need > self.width:
+            raise ValueError(
+                f"request needs {w_need} pages > table width {self.width}"
+            )
+        arr = np.asarray(prompt, np.int32)
+        # At least one suffix token must run through the model to
+        # produce sampling logits — cap reuse at len(prompt) - 1.
+        limit = len(prompt) - 1
+        if share:
+            shared, _, cow_page, cow_t = self._lookup(arr, limit)
+        else:
+            shared, cow_page, cow_t = [], -1, 0
+        m = len(shared)
+        self._reclaim(w_need - m)  # may raise; nothing mutated yet
+        fresh = [self._free.pop() for _ in range(w_need - m)]
+        for page in shared:
+            if self._ref[page] == 0:
+                self._stamp.pop(page, None)  # no longer evictable
+            self._ref[page] += 1
+        for page in fresh:
+            self._ref[page] = 1
+        row = self.tables[slot]
+        row[:] = self.sentinel
+        row[:m] = shared
+        row[m:w_need] = fresh
+        gather = row.copy()
+        if cow_page >= 0 and cow_t > 0:
+            gather[m] = cow_page
+            self.cow_copies += 1
+        if m or cow_t:
+            self.hits += 1
+            self.pages_reused += m
+        elif share:
+            self.misses += 1
+        return PageAdmission(
+            merge_start=m * p,
+            scan_start=m * p + cow_t,
+            gather_row=gather,
+            pages_shared=m,
+        )
+
+    def register(self, slot: int, prompt: list) -> None:
+        """Index every full page of a successfully prefilled prompt so
+        later admissions can share it. Pages already on the chain
+        (including the ones this admission itself reused) pass through;
+        a colliding-but-different index entry keeps precedence (the
+        duplicate page simply stays private to this slot)."""
+        p = self.page_size
+        arr = np.asarray(prompt, np.int32)
+        key = _ROOT
+        for j in range(len(prompt) // p):
+            toks = arr[j * p:(j + 1) * p]
+            nxt = self._chain(key, toks)
+            page = self._index.get(nxt)
+            if page is None:
+                page = int(self.tables[slot, j])
+                if page == self.sentinel or page in self._key_of:
+                    break  # defensive: never double-index a page
+                self._index[nxt] = page
+                self._key_of[page] = nxt
+                self._tokens_of[page] = toks.copy()
+                self._parent_of[page] = key
+                self._children.setdefault(key, set()).add(page)
+            key = nxt
+
+    def free_slot(self, slot: int, discard_index: bool = False) -> None:
+        """Release a slot's page references. Exclusive un-indexed pages
+        return to the free list; indexed pages whose refcount reaches 0
+        stay resident as evictable cache (LRU-stamped) — the reuse
+        window that holds the hit rate when the working set fits the
+        arena. `discard_index=True` (admission FAILURE): pages this row
+        eagerly indexed were never prefilled — a ref-0 page leaves the
+        index and frees instead of caching garbage (a still-referenced
+        indexed page is kept: any surviving sharer was admitted by a
+        call that already materialized its content)."""
+        row = self.tables[slot]
+        for page in row[row != self.sentinel]:
+            page = int(page)
+            self._ref[page] -= 1
+            if self._ref[page] == 0:
+                if page in self._key_of and discard_index:
+                    self._unindex(page)
+                    self._free.append(page)
+                elif page in self._key_of:
+                    self._clock += 1
+                    self._stamp[page] = self._clock
+                else:
+                    self._free.append(page)
+        row[:] = self.sentinel
+
+    def reset(self) -> None:
+        """Arena rebuilt from zeros (tick-failure recovery): every page
+        and every index entry is device-dead — forget it all. Victims
+        replay through admission, which re-prefills and re-registers;
+        shared prefixes re-share from the first replayed sighting."""
+        self.tables[:] = self.sentinel
+        self._ref[:] = 0
+        self._free = list(range(self.n_pages))
+        self._index.clear()
+        self._key_of.clear()
+        self._tokens_of.clear()
+        self._parent_of.clear()
+        self._children.clear()
+        self._stamp.clear()

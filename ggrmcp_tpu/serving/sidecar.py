@@ -335,6 +335,15 @@ class Sidecar:
                         finish = reason
             span.set(completion_tokens=len(token_ids), finish=finish)
             self._attribute_span(span, trace_id, speculative)
+        if finish == "overloaded":
+            # Paged-KV page-pool exhaustion discovered at admission
+            # (after submit already queued the request): same typed
+            # overload ladder as a submit-time shed — RESOURCE_EXHAUSTED
+            # here, HTTP 429 + Retry-After at the gateway.
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "server overloaded (pages): kv page pool exhausted",
+            )
         if finish == "error":
             await context.abort(
                 grpc.StatusCode.INTERNAL, "generation failed on the backend"
@@ -430,6 +439,14 @@ class Sidecar:
                 )
                 return
             if reason:
+                if reason == "overloaded":
+                    # Paged admission-time shed: typed overload, same
+                    # ladder as a submit-time OverloadedError.
+                    await context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        "server overloaded (pages): kv page pool "
+                        "exhausted",
+                    )
                 if reason == "error":
                     # Same contract as unary Generate: a backend failure
                     # is an INTERNAL status, not a normal-looking stream.
@@ -597,6 +614,7 @@ class Sidecar:
                     trace_ids=t.trace_ids, source=t.source,
                     spec_drafted=t.spec_drafted,
                     spec_accepted=t.spec_accepted,
+                    kv_pages_in_use=t.kv_pages_in_use,
                 )
                 for t in ticks
             ],

@@ -2,12 +2,17 @@
 capacities (VERDICT r1 #9 — KV-cache headroom).
 
 The single contiguous pool costs HBM = B × S_max regardless of
-occupancy, so 64 sessions and long contexts can't coexist. The
-TPU-native fix here is TIERING rather than paging: a few pools with
-static shapes (short×many, long×few) keep every decode tick a fully
-tiled MXU program with zero gather overhead — paged block tables would
-put a dynamic gather on the hot path, which XLA punishes far more than
-a GPU runtime does.
+occupancy, so 64 sessions and long contexts can't coexist. Tiering was
+this repo's first answer: a few pools with static shapes (short×many,
+long×few) keep every decode tick a fully tiled MXU program with zero
+gather overhead. Since then the paged KV plane (batching.paged_kv=on,
+docs/paged_kv.md) attacks the same waste at token granularity — pages
+are allocated to a request's actual length and shared prefixes are
+stored once — which covers most of what tiering bought, plus the
+prefix-thrash regime tiers never addressed. The two compose: each tier
+runs its own paged arena (a global paged_kv_pages budget is split
+across tiers by KV volume below), though a single paged pool is
+usually the simpler configuration now.
 
 HBM = Σ slots_i × seq_i instead of B_total × S_global_max. Example for
 llama-1b bf16 KV (16 layers × 8 kv-heads × 64): a flat 32×4096 pool is
@@ -43,7 +48,16 @@ class TieredBatcher:
         self.engine = engine
         self.cfg = cfg
         self.tiers: list[ContinuousBatcher] = []
-        for tier in cfg.kv_tiers:
+        # Paged mode with an explicit global page budget: split it
+        # across tiers proportional to each tier's KV volume
+        # (slots × max_seq), so every tier keeps the same relative
+        # headroom the contiguous pools had. 0 (auto) lets each tier
+        # auto-size to slots × max_seq / page_size.
+        paged = getattr(cfg, "paged_kv", "off") == "on"
+        budget = int(getattr(cfg, "paged_kv_pages", 0) or 0)
+        volumes = [int(t[0]) * int(t[1]) for t in cfg.kv_tiers]
+        total_volume = sum(volumes) or 1
+        for tier, volume in zip(cfg.kv_tiers, volumes):
             # [max_seq, slots] or [max_seq, slots, prefix_entries]:
             # the optional third element overrides the global prefix
             # pool size for THIS tier (0 = off). A tier whose workload
@@ -58,6 +72,10 @@ class TieredBatcher:
                 prefix_cache_entries=(
                     int(tier[2]) if len(tier) > 2
                     else cfg.prefix_cache_entries
+                ),
+                paged_kv_pages=(
+                    max(1, budget * volume // total_volume)
+                    if paged and budget else 0
                 ),
             )
             tier_batcher = ContinuousBatcher(engine, tier_cfg, eos_id=eos_id)

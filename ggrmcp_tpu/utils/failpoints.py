@@ -23,6 +23,9 @@ Hook sites (the names the serving plane evaluates):
   tick_fail      ContinuousBatcher._tick_step — before tick dispatch
   admit_fail     ContinuousBatcher._prefill_into_slots — admission round
   admit_slow     same site, latency variant (arm with ms=)
+  page_exhausted same site, per paged-KV row — forces the page
+                 allocator's exhaustion path (typed RESOURCE_EXHAUSTED
+                 shed; batching.paged_kv=on only)
   reconnect_fail ServiceDiscoverer._try_reconnect — before dialing
 
 Evaluation is cheap when nothing is armed (one dict lookup) and

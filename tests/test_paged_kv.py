@@ -1,0 +1,558 @@
+"""Paged KV cache tests (batching.paged_kv, docs/paged_kv.md).
+
+The contract under test, in order of importance:
+
+1. BIT-IDENTITY — greedy outputs with paged_kv=on are byte-equal to
+   the contiguous path (and to the engine's uncached generate) across
+   every admission path (fused / chunked / paged-prefix / interleaved),
+   under injected tick faults (chaos replay), and with speculative and
+   grammar rows in the batch. The contiguous path stays the off-mode
+   precisely so this is provable.
+2. SHARING — same-preamble admissions reference the SAME physical
+   pages (refcounts, kv_pages_shared), divergent pages copy-on-write,
+   and a working set that outgrows refcounts survives via LRU reuse of
+   refcount-0 pages (the thrash regime the slot-granular pool lost —
+   the slow-suite TestPrefixThrash pins the 3× working-set bound).
+3. SAFETY — page-pool exhaustion sheds typed ("overloaded" →
+   RESOURCE_EXHAUSTED → 429, the PR-2 ladder) and never corrupts
+   resident block tables; compile counts stay stable for mixed
+   shared/unshared batches; the host allocator's bookkeeping is exact.
+
+Marker `paged` (tier-1, `make test-paged`).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.core.config import (
+    BatchingConfig,
+    Config,
+    MeshConfig,
+    ServingConfig,
+)
+from ggrmcp_tpu.grammar import compile_schema
+from ggrmcp_tpu.models import llama
+from ggrmcp_tpu.ops.sampling import SamplingConfig
+from ggrmcp_tpu.serving.batching import ContinuousBatcher
+from ggrmcp_tpu.serving.engine import GenerationEngine
+from ggrmcp_tpu.serving.pages import (
+    PageAdmission,
+    PageAllocator,
+    PageExhaustedError,
+)
+from ggrmcp_tpu.serving.tiered import TieredBatcher
+from ggrmcp_tpu.utils import failpoints
+
+pytestmark = pytest.mark.paged
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return GenerationEngine(
+        llama.CONFIGS["tiny-llama"],
+        ServingConfig(mesh=MeshConfig(tensor=2, data=0)),
+    )
+
+
+@pytest.fixture(scope="module")
+def spec_engine():
+    """Draft-configured engine (draft = same arch, independent random
+    weights → realistic imperfect acceptance) for spec×paged tests."""
+    return GenerationEngine(
+        llama.CONFIGS["tiny-llama"],
+        ServingConfig(
+            mesh=MeshConfig(tensor=2, data=0),
+            speculative_draft="tiny-llama",
+        ),
+    )
+
+
+def paged_cfg(**kw) -> BatchingConfig:
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("kv_cache_max_seq", 256)
+    kw.setdefault("paged_kv", "on")
+    kw.setdefault("paged_kv_page_size", 8)
+    return BatchingConfig(**kw)
+
+
+def flat_cfg(**kw) -> BatchingConfig:
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("kv_cache_max_seq", 256)
+    return BatchingConfig(**kw)
+
+
+def prompt_of(n: int, salt: int = 0) -> list[int]:
+    return [(i * 13 + salt * 71 + 5) % 500 + 1 for i in range(n)]
+
+
+async def collect(batcher, prompt, max_new, seed=0, sampling=None,
+                  grammar=None):
+    out: list[int] = []
+    reason = None
+    async for ids, r in batcher.submit(
+        prompt, max_new, sampling or SamplingConfig(temperature=0.0),
+        seed=seed, grammar=grammar,
+    ):
+        out.extend(ids)
+        reason = r
+    return out, reason
+
+
+async def run_wave(engine, cfg, prompts, max_new=5):
+    """(outputs, batcher-after-stop) for a concurrent greedy wave."""
+    batcher = ContinuousBatcher(engine, cfg)
+    batcher.start()
+    try:
+        results = await asyncio.gather(*(
+            collect(batcher, p, max_new, seed=i)
+            for i, p in enumerate(prompts)
+        ))
+    finally:
+        await batcher.stop()
+    for out, reason in results:
+        assert reason in ("stop", "length") and len(out) >= 1
+    return [out for out, _ in results], batcher
+
+
+# ---------------------------------------------------------------------------
+# Host allocator (no device)
+# ---------------------------------------------------------------------------
+
+
+class TestPageAllocator:
+    def test_cold_admit_allocates_exclusive_pages(self):
+        alloc = PageAllocator(16, 4, slots=2, table_width=8)
+        adm = alloc.admit(0, list(range(10)), need_len=14)
+        assert isinstance(adm, PageAdmission)
+        assert adm.merge_start == 0 and adm.scan_start == 0
+        assert alloc.in_use() == 4  # ceil(14 / 4)
+        assert (alloc.tables[0][:4] != alloc.sentinel).all()
+        assert (alloc.tables[0][4:] == alloc.sentinel).all()
+        assert alloc.misses == 1 and alloc.hits == 0
+
+    def test_register_then_share_refcounts(self):
+        alloc = PageAllocator(16, 4, slots=3, table_width=8)
+        prompt = list(range(11))  # 2 full pages (8 tokens) + tail
+        alloc.admit(0, prompt, need_len=12)
+        alloc.register(0, prompt)
+        adm = alloc.admit(1, prompt, need_len=12)
+        # Both full pages shared, refcount 2; the tail page is private.
+        assert adm.merge_start == 8 and adm.pages_shared == 2
+        assert alloc.shared() == 2
+        assert (alloc.tables[0][:2] == alloc.tables[1][:2]).all()
+        assert alloc.tables[0][2] != alloc.tables[1][2]
+        assert alloc.hits == 1
+
+    def test_cow_on_divergent_page(self):
+        alloc = PageAllocator(16, 4, slots=2, table_width=8)
+        a = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        alloc.admit(0, a, need_len=10)
+        alloc.register(0, a)  # indexes pages [1..4] and [5..8]
+        # Diverges inside the SECOND page: shares page 0, CoW page 1.
+        b = [1, 2, 3, 4, 5, 6, 99, 98, 97]
+        adm = alloc.admit(1, b, need_len=10)
+        assert adm.merge_start == 4  # one full shared page
+        assert adm.scan_start == 6  # + 2 CoW-overlap tokens [5, 6]
+        assert adm.gather_row[1] == alloc.tables[0][1]  # source page
+        assert adm.gather_row[1] != alloc.tables[1][1]  # != own page
+        assert alloc.cow_copies == 1
+
+    def test_free_keeps_indexed_pages_evictable_then_lru_evicts(self):
+        alloc = PageAllocator(4, 4, slots=2, table_width=4)
+        p1 = list(range(9))
+        alloc.admit(0, p1, need_len=9)  # 3 pages
+        alloc.register(0, p1)
+        alloc.free_slot(0)
+        assert alloc.in_use() == 2  # 2 indexed pages stay resident
+        # A re-admission still hits the cached pages...
+        adm = alloc.admit(0, p1, need_len=9)
+        assert adm.pages_shared == 2
+        alloc.free_slot(0)
+        # ...until allocation pressure LRU-evicts them.
+        alloc.admit(1, list(range(100, 116)), need_len=16)  # all 4 pages
+        assert alloc.in_use() == 4
+        alloc.free_slot(1)
+        assert alloc.admit(0, p1, need_len=9).pages_shared == 0
+
+    def test_exhaustion_is_all_or_nothing(self):
+        alloc = PageAllocator(4, 4, slots=2, table_width=8)
+        alloc.admit(0, list(range(10)), need_len=12)  # 3 of 4 pages
+        before = alloc.tables.copy()
+        with pytest.raises(PageExhaustedError):
+            alloc.admit(1, list(range(50, 60)), need_len=12)  # needs 3
+        assert (alloc.tables == before).all()  # nothing mutated
+        assert alloc.in_use() == 3
+
+    def test_reset_forgets_everything(self):
+        alloc = PageAllocator(8, 4, slots=2, table_width=4)
+        p = list(range(9))
+        alloc.admit(0, p, need_len=9)
+        alloc.register(0, p)
+        alloc.reset()
+        assert alloc.in_use() == 0
+        assert (alloc.tables == alloc.sentinel).all()
+        assert alloc.admit(0, p, need_len=9).pages_shared == 0
+
+    def test_share_false_consults_nothing(self):
+        alloc = PageAllocator(16, 4, slots=2, table_width=8)
+        p = list(range(11))
+        alloc.admit(0, p, need_len=12)
+        alloc.register(0, p)
+        adm = alloc.admit(1, p, need_len=12, share=False)
+        assert adm.merge_start == 0 and adm.scan_start == 0
+        assert alloc.shared() == 0
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity: paged on == paged off == engine.generate
+# ---------------------------------------------------------------------------
+
+
+class TestPagedBitIdentity:
+    async def test_all_admission_paths_match_flat_and_engine(self, engine):
+        """One mixed wave exercising fused (short cold), paged-prefix
+        (shared preamble), and chunked (long cold) admission — paged-on
+        outputs byte-equal to paged-off AND the uncached engine."""
+        head = prompt_of(24)
+        prompts = (
+            [prompt_of(12, salt=50)]  # fused short
+            + [head + prompt_of(6, salt=s) for s in range(4)]  # shared
+            + [prompt_of(80, salt=9)]  # chunked long
+        )
+        expected, _ = engine.generate(prompts, max_new_tokens=5, seed=0)
+        outs_off, _ = await run_wave(
+            engine, flat_cfg(prefill_chunk=32), prompts
+        )
+        outs_on, paged = await run_wave(
+            engine, paged_cfg(prefill_chunk=32), prompts
+        )
+        assert outs_off == expected
+        assert outs_on == expected
+        stats = paged.counter_stats()
+        assert stats["paged_prefix_hits"] >= 1
+        assert stats["prefix_cache_hits"] + stats["prefix_cache_misses"] \
+            == len(prompts)
+
+    async def test_repeat_prompt_hits_and_matches(self, engine):
+        prompt = prompt_of(40)
+        expected, _ = engine.generate([prompt], max_new_tokens=6, seed=0)
+        batcher = ContinuousBatcher(engine, paged_cfg())
+        batcher.warmup()  # covers the paged warm ladder
+        batcher.start()
+        try:
+            out1, _ = await collect(batcher, prompt, 6)
+            assert (batcher.prefix_hits, batcher.prefix_misses) == (0, 1)
+            out2, _ = await collect(batcher, prompt, 6)
+            assert batcher.prefix_hits == 1
+            assert batcher.pages.pages_reused >= 4  # 32+ shared tokens
+        finally:
+            await batcher.stop()
+        assert out1 == expected[0]
+        assert out2 == expected[0]
+
+    async def test_interleaved_admission_matches(self, engine):
+        """Paged + prefill_interleave: the chunk-per-tick mini rides
+        unchanged and _ilv_finish merges into pages. The engine's
+        uncached generate is the reference — the contiguous interleaved
+        path's equality to it is already pinned by test_interleave."""
+        prompts = [prompt_of(16, salt=s) for s in range(3)] + [
+            prompt_of(100, salt=7)
+        ]
+        expected, _ = engine.generate(prompts, max_new_tokens=5, seed=0)
+        outs_on, paged = await run_wave(
+            engine, paged_cfg(prefill_chunk=32, prefill_interleave="on"),
+            prompts,
+        )
+        assert outs_on == expected
+
+    async def test_chaos_tick_faults_replay_bit_identical(self, engine):
+        """Injected tick faults: the paged arena dies with the donated
+        call; block tables are HOST state — recovery resets the
+        allocator and replay re-maps through admission. Greedy outputs
+        stay byte-equal to the fault-free contiguous run."""
+        head = prompt_of(24)
+        prompts = [head + prompt_of(6, salt=s) for s in range(4)] + [
+            prompt_of(60, salt=8)
+        ]
+        outs_off, _ = engine.generate(prompts, max_new_tokens=5, seed=0)
+        failpoints.registry.arm("tick_fail", every=4, times=2)
+        try:
+            outs_chaos, chaos = await run_wave(
+                engine,
+                paged_cfg(prefill_chunk=32, tick_retry_limit=3),
+                prompts,
+            )
+        finally:
+            failpoints.registry.disarm()
+        assert outs_chaos == outs_off
+        assert chaos.replayed >= 1
+
+    async def test_speculative_rows_match(self, spec_engine):
+        """Spec draft/verify ticks over the paged pool: greedy rows
+        bitwise what the plain path emits, and a same-preamble burst
+        shares pages even though the verify tick owns the cache."""
+        head = prompt_of(20)
+        prompts = [head + prompt_of(4, salt=s) for s in range(4)]
+        expected, _ = spec_engine.generate(prompts, max_new_tokens=5, seed=0)
+        outs_on, paged = await run_wave(
+            spec_engine, paged_cfg(speculative="on"), prompts
+        )
+        assert outs_on == expected
+        assert paged.spec_ticks > 0
+        # The one-round burst shares the first row's eagerly indexed
+        # preamble pages (2 full pages of the 20-token head at page 8).
+        assert paged.prefix_hits >= 3
+
+    async def test_grammar_row_in_paged_batch(self, engine):
+        """A DFA-constrained row and plain greedy rows share one paged
+        batch; the plain rows stay byte-equal to the contiguous path
+        and the constrained row completes its schema."""
+        schema = {
+            "type": "object",
+            "properties": {"ok": {"type": "boolean"}},
+            "required": ["ok"],
+        }
+        g = compile_schema(schema, vocab_size=512)
+        plain = prompt_of(20)
+        expected, _ = engine.generate([plain], max_new_tokens=5, seed=0)
+        batcher = ContinuousBatcher(engine, paged_cfg())
+        batcher.start()
+        try:
+            (out_plain, _), (out_g, reason_g) = await asyncio.gather(
+                collect(batcher, plain, 5),
+                collect(batcher, prompt_of(20, salt=3), 64, grammar=g),
+            )
+        finally:
+            await batcher.stop()
+        assert out_plain == expected[0]
+        assert reason_g == "grammar_complete" and len(out_g) >= 1
+
+    async def test_int8_kv_pages_match_contiguous_int8(self):
+        engine8 = GenerationEngine(
+            llama.CONFIGS["tiny-llama"],
+            ServingConfig(
+                mesh=MeshConfig(tensor=2, data=0), kv_cache_dtype="int8"
+            ),
+        )
+        head = prompt_of(24)
+        prompts = [head + prompt_of(6, salt=s) for s in range(3)]
+        expected, _ = engine8.generate(prompts, max_new_tokens=5, seed=0)
+        outs_on, _ = await run_wave(engine8, paged_cfg(), prompts)
+        assert outs_on == expected
+
+
+# ---------------------------------------------------------------------------
+# Sharing mechanics on the live batcher
+# ---------------------------------------------------------------------------
+
+
+class TestPagedSharing:
+    async def test_concurrent_same_preamble_share_physical_pages(
+        self, engine
+    ):
+        """While a same-preamble wave decodes, the preamble's pages are
+        refcount-shared — stored once, referenced by every slot."""
+        head = prompt_of(32)
+        batcher = ContinuousBatcher(engine, paged_cfg())
+        batcher.start()
+        shared_peak = {"v": 0}
+
+        async def probe():
+            while True:
+                shared_peak["v"] = max(
+                    shared_peak["v"], batcher.pages.shared()
+                )
+                await asyncio.sleep(0.002)
+
+        try:
+            await collect(batcher, head + [401], 2)  # seed the index
+            probe_task = asyncio.ensure_future(probe())
+            try:
+                await asyncio.gather(*(
+                    collect(batcher, head + [410 + i], 24, seed=i)
+                    for i in range(4)
+                ))
+            finally:
+                probe_task.cancel()
+        finally:
+            await batcher.stop()
+        # 32-token preamble at page 8 = 4 full pages shared while the
+        # wave decodes; every wave member hit the index.
+        assert shared_peak["v"] >= 4
+        assert batcher.pages.hits >= 4
+        assert batcher.pages.pages_reused >= 16
+
+    async def test_tick_records_carry_page_occupancy(self, engine):
+        batcher = ContinuousBatcher(engine, paged_cfg())
+        batcher.start()
+        try:
+            await collect(batcher, prompt_of(20), 6)
+        finally:
+            await batcher.stop()
+        ticks, _ = batcher.flight_snapshot()
+        assert ticks and any(t.kv_pages_in_use > 0 for t in ticks)
+        assert "kvPagesInUse" in ticks[-1].to_dict()
+
+    async def test_stats_flow_to_proto(self, engine):
+        """counter_stats' paged keys construct a ServingStatsResponse —
+        the loud-drift contract the proto↔metrics test leans on."""
+        from ggrmcp_tpu.rpc.pb import serving_pb2
+
+        batcher = ContinuousBatcher(engine, paged_cfg())
+        batcher.start()
+        try:
+            await collect(batcher, prompt_of(20), 3)
+            await collect(batcher, prompt_of(20), 3)
+        finally:
+            await batcher.stop()
+        msg = serving_pb2.ServingStatsResponse(**batcher.stats())
+        assert msg.kv_pages_total == batcher.pages.n_pages
+        assert msg.kv_pages_in_use > 0
+        assert msg.paged_prefix_hits >= 1
+
+    async def test_tiered_composes_with_paged(self, engine):
+        head = prompt_of(24)
+        prompts = [head + prompt_of(6, salt=s) for s in range(4)]
+        expected, _ = engine.generate(prompts, max_new_tokens=5, seed=0)
+        tiered = TieredBatcher(engine, BatchingConfig(
+            kv_tiers=[[64, 4], [256, 2]],
+            paged_kv="on", paged_kv_page_size=8,
+        ))
+        tiered.start()
+        try:
+            results = await asyncio.gather(*(
+                collect(tiered, p, 5, seed=i)
+                for i, p in enumerate(prompts)
+            ))
+        finally:
+            await tiered.stop()
+        assert [out for out, _ in results] == expected
+        stats = tiered.stats()
+        assert stats["kv_pages_total"] == sum(
+            t.pages.n_pages for t in tiered.tiers
+        )
+
+    async def test_mixed_batch_compile_count_stable(self, engine):
+        """Mixed shared/unshared/sampled rows all ride ONE compiled
+        paged tick — zero new tick compiles after the first wave."""
+        head = prompt_of(24)
+        batcher = ContinuousBatcher(engine, paged_cfg())
+        batcher.start()
+        try:
+            await collect(batcher, head + [400], 4)  # warm tick + index
+            before = batcher._tick._cache_size()
+            await asyncio.gather(
+                collect(batcher, head + [401], 4),  # shared
+                collect(batcher, prompt_of(12, salt=60), 4),  # cold
+                collect(batcher, prompt_of(12, salt=61), 4,
+                        sampling=SamplingConfig(temperature=0.9), seed=5),
+            )
+            assert batcher._tick._cache_size() == before
+        finally:
+            await batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# Exhaustion: typed shed, no corruption
+# ---------------------------------------------------------------------------
+
+
+class TestPageExhaustion:
+    async def test_tiny_pool_sheds_typed_and_stays_sane(self, engine):
+        """A pool too small for the request sheds "overloaded" (the
+        RESOURCE_EXHAUSTED → 429 ladder) and resident tables survive:
+        a live request keeps decoding correctly and a smaller follow-up
+        admits fine."""
+        expected, _ = engine.generate(
+            [prompt_of(10)], max_new_tokens=40, seed=0
+        )
+        batcher = ContinuousBatcher(
+            engine, paged_cfg(paged_kv_pages=10)
+        )
+        batcher.start()
+        try:
+            live = asyncio.ensure_future(collect(batcher, prompt_of(10), 40))
+            await asyncio.sleep(0.05)  # let it admit (7 of 10 pages)
+            # 200 + 8 + 1 tokens = 27 pages — more than the whole
+            # 10-page arena, so the shed is deterministic whether or
+            # not the live request has finished yet.
+            out, reason = await collect(batcher, prompt_of(200, salt=5), 8)
+            assert reason == "overloaded" and out == []
+            assert batcher.shed == 1
+            out_live, _ = await live
+            assert out_live == expected[0]  # bystander unharmed
+            out2, r2 = await collect(batcher, prompt_of(10, salt=2), 4)
+            assert r2 in ("stop", "length") and len(out2) >= 1
+        finally:
+            await batcher.stop()
+
+    async def test_failpoint_forces_exhaustion_path(self, engine):
+        batcher = ContinuousBatcher(engine, paged_cfg())
+        batcher.start()
+        failpoints.registry.arm("page_exhausted", every=1, times=1)
+        try:
+            out, reason = await collect(batcher, prompt_of(12), 4)
+            assert reason == "overloaded" and batcher.shed == 1
+            out2, r2 = await collect(batcher, prompt_of(12), 4)
+            assert r2 in ("stop", "length") and len(out2) >= 1
+        finally:
+            failpoints.registry.disarm()
+            await batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# Config hygiene (satellite: typed composition errors)
+# ---------------------------------------------------------------------------
+
+
+class TestPagedConfig:
+    def _cfg(self, **batching) -> Config:
+        cfg = Config()
+        for key, value in batching.items():
+            setattr(cfg.serving.batching, key, value)
+        return cfg
+
+    def test_defaults_validate(self):
+        self._cfg(paged_kv="on").validate()
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="paged_kv"):
+            self._cfg(paged_kv="maybe").validate()
+
+    def test_prefix_pool_superseded(self):
+        with pytest.raises(ValueError, match="supersedes"):
+            self._cfg(paged_kv="on", prefix_cache_entries=4).validate()
+
+    def test_kv_ring_mutually_exclusive(self):
+        cfg = self._cfg(paged_kv="on")
+        cfg.serving.kv_ring = True
+        cfg.serving.model = "tiny-mistral"
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            cfg.validate()
+
+    def test_page_size_must_divide_max_seq(self):
+        with pytest.raises(ValueError, match="divide"):
+            self._cfg(
+                paged_kv="on", paged_kv_page_size=24, kv_cache_max_seq=256
+            ).validate()
+
+    def test_page_size_must_divide_tier_max_seq(self):
+        with pytest.raises(ValueError, match="tier"):
+            self._cfg(
+                paged_kv="on", paged_kv_page_size=16,
+                kv_tiers=[[72, 4], [256, 2]], kv_cache_max_seq=256,
+            ).validate()
+
+    def test_tier_prefix_entries_superseded(self):
+        with pytest.raises(ValueError, match="per-tier prefix"):
+            self._cfg(
+                paged_kv="on", kv_tiers=[[64, 4, 2], [256, 2]],
+            ).validate()
+
+    def test_batcher_mirrors_validation(self, engine):
+        with pytest.raises(ValueError, match="supersedes"):
+            ContinuousBatcher(
+                engine, paged_cfg(prefix_cache_entries=2)
+            )

@@ -352,18 +352,31 @@ class TestPrefixThrash:
 
     N_SESSIONS = 64
 
-    async def _run(self, engine, n_preambles: int, entries: int):
+    async def _run(self, engine, n_preambles: int, entries: int,
+                   paged: bool = False):
         """(hit_rate, seconds) for N_SESSIONS concurrent calls cycling
         round-robin over n_preambles distinct 32-token preambles
-        against an `entries`-entry pool (0 = pool off)."""
+        against an `entries`-entry pool (0 = pool off). `paged=True`
+        swaps the slot-granular pool for the paged KV cache
+        (batching.paged_kv=on) with the SAME KV HBM budget the 16-slot
+        contiguous pool uses — sharing and exact-fit pages are what
+        must carry the working set, not extra memory."""
         import time
 
-        cfg = batching_cfg(
-            max_batch_size=16,
-            prefix_cache_entries=entries,
-            prefix_cache_min_seq=8,
-            prefix_cache_max_seq=64,
-        )
+        if paged:
+            cfg = batching_cfg(
+                max_batch_size=16,
+                prefix_cache_entries=0,
+                paged_kv="on",
+                paged_kv_page_size=8,
+            )
+        else:
+            cfg = batching_cfg(
+                max_batch_size=16,
+                prefix_cache_entries=entries,
+                prefix_cache_min_seq=8,
+                prefix_cache_max_seq=64,
+            )
         batcher = ContinuousBatcher(engine, cfg)
         batcher.warmup()
         batcher.start()
@@ -425,6 +438,30 @@ class TestPrefixThrash:
         # into a multiple-of-baseline regression.
         assert thrash_s <= 3.0 * cold_s, (
             f"thrash {thrash_s:.1f}s vs no-pool {cold_s:.1f}s"
+        )
+
+    async def test_paged_holds_hit_rate_at_3x_working_set(self, engine):
+        """The cliff the paged KV cache exists to remove (ROADMAP open
+        item 2; docs/BENCH.md §"Prefix-pool thrash regime"): the SAME
+        12-preamble / 3×-the-old-pool working set that collapses the
+        slot-granular pool to ~0.28 must hold ≥ 0.9 under paging —
+        token-level pages store each distinct preamble once, exactly
+        sized, so the whole working set stays resident in the HBM
+        budget 4 padded pool entries wasted on a fraction of it."""
+        paged_rate, paged_s = await self._run(
+            engine, 12, entries=0, paged=True
+        )
+        _, cold_s = await self._run(engine, 12, entries=0)
+        print(
+            f"\npaged-thrash: 12 preambles hit-rate {paged_rate:.2f} "
+            f"({paged_s:.1f}s), no-pool control {cold_s:.1f}s"
+        )
+        assert paged_rate >= 0.9, (
+            f"paged cache must hold the 3x working set, got "
+            f"{paged_rate:.2f}"
+        )
+        assert paged_s <= 3.0 * cold_s, (
+            f"paged {paged_s:.1f}s vs no-pool {cold_s:.1f}s"
         )
 
 
