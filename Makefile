@@ -6,8 +6,8 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: proto proto-check descriptors test test-all test-fast test-chaos \
-  test-obs test-grammar test-spec-batch test-paged bench-cpu smoke e2e \
-  lint ci-local preflight clean
+  test-obs test-grammar test-spec-batch test-paged test-tp bench-cpu \
+  smoke e2e lint ci-local preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
 # No protoc on this image? scripts/regen_serving_pb2.py regenerates
@@ -79,6 +79,17 @@ test-spec-batch:
 # serving/pages.py + paged-batcher work.
 test-paged:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m paged
+
+# Tensor-parallel serving net alone, on a FORCED 2-DEVICE CPU mesh —
+# the stand-in recipe for a real >=2-chip TPU window
+# (docs/tensor_parallel_serving.md): 1-chip vs 2-chip greedy
+# bit-identity across admission paths, paged x TP, spec x TP,
+# chaos x TP, compile-count stability, and the sidecar TP e2e with a
+# real HF tokenizer. Tier-1 runs the same tests on the 8-device mesh;
+# this target pins the exact 2-device topology the issue names.
+test-tp:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+	  $(PY) -m pytest tests/ -q -m tp
 
 # CPU smoke of the full bench, including the mixed long-prompt+decode
 # workload phase (interleaved prefill on — A/B the serialized baseline

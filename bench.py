@@ -60,6 +60,18 @@ Environment knobs:
                          (default: the target model itself — same
                          architecture, independently initialized
                          weights unless a checkpoint is configured).
+  GGRMCP_BENCH_TP        tensor-parallel serving A/B phase: N>=2 picks
+                         the mesh width (1-chip vs tensor=N engines,
+                         tokens/s + per-chip tokens/s + mesh identity +
+                         weight-load host RSS); "on"/"1" = all devices;
+                         "0"/"off" skips. Default: on for CPU full
+                         benches with >=2 virtual devices, off on TPU
+                         (the watcher's stage_8b_tp opts in).
+  GGRMCP_BENCH_TP_SLOTS  slot-pool size for the TP phase (default 8)
+  GGRMCP_BENCH_TOKENIZER path to a HF tokenizer.json served by the
+                         sidecar (labels the artifact `tokenizer:
+                         llama3` when it is the 128,256-vocab Llama-3
+                         file); empty = hermetic byte-level
   GGRMCP_BENCH_PAGED     paged KV cache A/B phase ("on" by default
                          off-TPU, "off" skips): runs batching.paged_kv
                          on vs off on the same engine over a shared-
@@ -145,8 +157,21 @@ def _setup_jax():
     one physical core only adds partition/collective overhead to the
     fallback number (measured 4x on the full stack: 45 vs 11 calls/s).
     Multi-chip sharding validation is the dryrun's job
-    (__graft_entry__.dryrun_multichip), not the bench's."""
+    (__graft_entry__.dryrun_multichip), not the bench's.
+    GGRMCP_BENCH_HOST_DEVICES=N opts a CPU run into N virtual devices
+    (the TP A/B phase's stand-in mesh) — deliberately NOT the default,
+    so headline CPU numbers stay single-device-comparable across
+    rounds."""
     force_cpu = os.environ.get("GGRMCP_BENCH_CPU") == "1"
+    host_devs = os.environ.get("GGRMCP_BENCH_HOST_DEVICES", "")
+    if host_devs and "xla_force_host_platform_device_count" not in (
+        os.environ.get("XLA_FLAGS", "")
+    ):
+        # Must land before jax initializes its backends.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={int(host_devs)}"
+        ).strip()
     import jax
 
     # Persistent XLA compilation cache: compiles amortize across bench
@@ -388,8 +413,15 @@ async def _run_bench() -> dict:
     from ggrmcp_tpu.core.config import ObservabilityConfig
 
     obs_on = os.environ.get("GGRMCP_BENCH_OBS", "on") != "off"
+    # Real tokenizer (GGRMCP_BENCH_TOKENIZER → serving.tokenizer_path):
+    # the llama3-8b ladder stage points this at the 128,256-vocab
+    # Llama-3 tokenizer.json when one is on disk; the artifact labels
+    # the run `tokenizer: llama3` so captures with and without the
+    # real vocabulary are never conflated.
+    tokenizer_path = os.environ.get("GGRMCP_BENCH_TOKENIZER", "")
     serving = ServingConfig(
         model=model,
+        tokenizer_path=tokenizer_path,
         observability=ObservabilityConfig(enabled=obs_on),
         quantize=quantize,
         kv_cache_dtype=kv_dtype,
@@ -541,7 +573,24 @@ async def _run_bench() -> dict:
             # Random weights in quantized form (perf staging — same op
             # graph and HBM traffic as real weights; text meaningless).
             **({"synthetic_weights": True} if synth else {}),
-            "tokenizer": serving.tokenizer_path or "byte-level",
+            # "llama3" = the real 128,256-vocab Llama-3 tokenizer.json
+            # was served; any other HF file is labeled by vocab size.
+            "tokenizer": (
+                "byte-level" if not serving.tokenizer_path
+                else (
+                    "llama3"
+                    if sidecar.tokenizer.vocab_size == 128256
+                    else f"hf-{sidecar.tokenizer.vocab_size}"
+                )
+            ),
+            # Mesh identity (docs/tensor_parallel_serving.md): which
+            # mesh the ticks sharded over, and whether any sharding
+            # spec fell back to replication (0 = true TP serving).
+            **(
+                sidecar.generation.mesh_stats()
+                if sidecar.generation is not None else {}
+            ),
+            "tokens_per_sec_per_chip": round(tokens_per_sec / n_chips, 1),
             "sessions": sessions,
             "total_calls": total,
             "max_new_tokens": max_new,
@@ -1164,6 +1213,22 @@ async def _run_bench() -> dict:
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: paged phase failed: {exc!r}", file=sys.stderr)
 
+    # Tensor-parallel serving A/B (GGRMCP_BENCH_TP,
+    # docs/tensor_parallel_serving.md): same isolation rationale —
+    # runs after the serving stack is down, on its own engines.
+    tp = {}
+    want_tp = os.environ.get("GGRMCP_BENCH_TP")
+    if want_tp not in (None, "", "0", "off") or (
+        want_tp is None and not headline_only and not on_tpu
+        and len(devices) >= 2
+    ):
+        try:
+            tp = await _tp_bench(
+                model, max_new, tick_steps, quantize, kv_dtype, synth,
+            )
+        except Exception as exc:  # secondary phase must not sink the run
+            print(f"bench: tp phase failed: {exc!r}", file=sys.stderr)
+
     proxy = {}
     if not headline_only:
         try:
@@ -1172,7 +1237,130 @@ async def _run_bench() -> dict:
             print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
     return {
         **headline, **hbm, **prefix, **longp, **mixed, **grammar,
-        **ticktime, **specbatch, **paged, **proxy,
+        **ticktime, **specbatch, **paged, **tp, **proxy,
+    }
+
+
+async def _tp_bench(
+    model: str, max_new: int, tick_steps, quantize: str, kv_dtype: str,
+    synth: bool,
+) -> dict:
+    """Tensor-parallel serving A/B (docs/tensor_parallel_serving.md):
+    the SAME model geometry served by a 1-chip engine and an N-chip
+    tensor-mesh engine, driven by the same greedy decode-bound
+    workload. Exports tokens/s both ways, per-chip tokens/s on the
+    mesh, the mesh identity (shape + spec downgrades — 0 downgrades is
+    the "really TP" gate), and the weight-materialization peak host
+    RSS (weights.last_load_stats when an HF checkpoint streamed in
+    sharded; otherwise RSS around the sharded init). On a one-core CPU
+    stand-in the mesh side is SLOWER (partitioning overhead, no extra
+    silicon) — the phase exists for the ≥2-chip TPU window
+    (tpu_watch.sh stage_8b_tp), where per-chip scaling is the story.
+    GGRMCP_BENCH_TP: N>=2 picks the mesh width; "on"/"1" = all
+    devices; "0"/"off" skips."""
+    import asyncio as _asyncio
+    import resource
+
+    import jax
+
+    from ggrmcp_tpu.core.config import (
+        BatchingConfig, MeshConfig, ObservabilityConfig, ServingConfig,
+    )
+    from ggrmcp_tpu.models import get_model
+    from ggrmcp_tpu.ops.sampling import SamplingConfig
+    from ggrmcp_tpu.parallel import mesh as mesh_mod
+    from ggrmcp_tpu.serving import weights as weights_mod
+    from ggrmcp_tpu.serving.batching import ContinuousBatcher
+    from ggrmcp_tpu.serving.engine import GenerationEngine
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        # A 1-device platform (v5e-1 window, default CPU fallback)
+        # cannot measure TP; record the skip honestly instead of
+        # failing the phase. CPU runs can opt into a virtual mesh with
+        # GGRMCP_BENCH_HOST_DEVICES=N.
+        return {"tp_skipped": "single-device platform"}
+    raw = os.environ.get("GGRMCP_BENCH_TP", "")
+    n = len(devices) if raw in ("", "1", "on") else int(raw)
+    n = max(2, min(n, len(devices)))
+    _, mcfg = get_model(model)
+    slots = int(os.environ.get("GGRMCP_BENCH_TP_SLOTS", "8"))
+    calls = 3 * slots
+    budget = max(16, max_new)
+    greedy = SamplingConfig(temperature=0.0)
+    loop = _asyncio.get_running_loop()
+
+    def serving_cfg():
+        return ServingConfig(
+            model=model, quantize=quantize, kv_cache_dtype=kv_dtype,
+            synthetic_weights=synth,
+            observability=ObservabilityConfig(enabled=False),
+        )
+
+    runs: dict[int, dict] = {}
+    for chips in (1, n):
+        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+        engine = GenerationEngine(
+            mcfg, serving_cfg(),
+            mesh=mesh_mod.build_mesh(
+                MeshConfig(tensor=chips, data=1), devices[:chips]
+            ),
+        )
+        rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+        batcher = ContinuousBatcher(engine, BatchingConfig(
+            max_batch_size=slots,
+            kv_cache_max_seq=512,
+            decode_steps_per_tick=tick_steps,
+        ))
+        await loop.run_in_executor(None, batcher.warmup)
+        batcher.start()
+        try:
+            async def call(i: int, b=batcher):
+                out = []
+                async for ids, _reason in b.submit(
+                    [3 + (i * 13) % 200, 7, (i * 29) % 200 + 3],
+                    budget, greedy, seed=i,
+                ):
+                    out.extend(ids)
+                return len(out)
+
+            await _asyncio.gather(*(call(1000 + i) for i in range(slots)))
+            t0 = time.perf_counter()
+            tokens = sum(await _asyncio.gather(
+                *(call(i) for i in range(calls))
+            ))
+            elapsed = time.perf_counter() - t0
+        finally:
+            await batcher.stop()
+        runs[chips] = {
+            "tokens_per_sec": tokens / elapsed,
+            **engine.mesh_stats(),
+            "init_rss_mb": round(rss1 - rss0, 1),
+        }
+    one, many = runs[1], runs[n]
+    load_stats = dict(weights_mod.last_load_stats)
+    return {
+        "tp_model": model,
+        "tp_chips_ab": n,
+        "tp_calls": calls,
+        "tp_1chip_tokens_per_sec": round(one["tokens_per_sec"], 1),
+        "tp_mesh_tokens_per_sec": round(many["tokens_per_sec"], 1),
+        "tp_mesh_tokens_per_sec_per_chip": round(
+            many["tokens_per_sec"] / n, 1
+        ),
+        "tp_scaling_pct": round(
+            (many["tokens_per_sec"] / one["tokens_per_sec"] - 1.0)
+            * 100.0, 1
+        ) if one["tokens_per_sec"] > 0 else 0.0,
+        "tp_mesh_shape": many["mesh_shape"],
+        "tp_mesh_spec_downgrades": many["mesh_spec_downgrades"],
+        "tp_init_rss_mb": many["init_rss_mb"],
+        **({
+            "tp_weight_load_peak_host_rss_mb": load_stats.get(
+                "weight_load_peak_host_rss_mb"
+            ),
+            "tp_weight_load_s": load_stats.get("weight_load_s"),
+        } if load_stats else {}),
     }
 
 

@@ -547,6 +547,13 @@ class ServingConfig:
     # checkpoint's config.json and `model` is ignored
     # (serving/weights.py). Mutually exclusive with checkpoint_path.
     hf_checkpoint_path: str = ""
+    # Flagship-fallback opt-in (ROADMAP item 1 / the TP watcher ladder):
+    # when hf_checkpoint_path is set but the directory is ABSENT, fall
+    # back to serving `model` with random init (real geometry and
+    # tokenizer, meaningless text) instead of failing startup. Off by
+    # default — a production config pointing at missing weights must
+    # die loudly, not quietly serve noise.
+    hf_checkpoint_optional: bool = False
     # HuggingFace tokenizer.json path (empty → hermetic byte tokenizer).
     tokenizer_path: str = ""
     # Weight quantization for decoder serving: "" (off) or "int8"

@@ -86,6 +86,10 @@ _SERVING_HELP = {
     "paged_prefix_hits":
         "admissions that reused shared prefix pages or a CoW source",
     "paged_cow_copies": "divergent-page copy-on-writes",
+    "tp_chips": "mesh tensor-axis size decode ticks shard over",
+    "mesh_devices": "devices in the serving mesh",
+    "mesh_spec_downgrades":
+        "sharding specs downgraded to replication (0 = true TP serving)",
 }
 
 _SERVING_HIST_HELP = {
@@ -124,8 +128,10 @@ def serving_histogram_names() -> list[str]:
 
 
 def serving_gauge_names() -> list[str]:
-    """Gauge names derived from the descriptor: every scalar
-    (non-repeated) field that is not part of a histogram triplet."""
+    """Gauge names derived from the descriptor: every NUMERIC scalar
+    (non-repeated) field that is not part of a histogram triplet.
+    String fields (mesh_shape) carry identity, not magnitude — they
+    export as labels on the info series instead (serving_info_names)."""
     desc = serving_pb2.ServingStatsResponse.DESCRIPTOR
     hist_members = set()
     for base in serving_histogram_names():
@@ -133,7 +139,21 @@ def serving_gauge_names() -> list[str]:
     return [
         f.name
         for f in desc.fields
-        if not _is_repeated(f) and f.name not in hist_members
+        if not _is_repeated(f)
+        and f.name not in hist_members
+        and f.cpp_type != f.CPPTYPE_STRING
+    ]
+
+
+def serving_info_names() -> list[str]:
+    """String-typed scalar fields: exported Prometheus-info-style —
+    `gateway_backend_serving_mesh_info{target, mesh_shape} 1` — so the
+    mesh identity is joinable in PromQL without faking a number."""
+    desc = serving_pb2.ServingStatsResponse.DESCRIPTOR
+    return [
+        f.name
+        for f in desc.fields
+        if not _is_repeated(f) and f.cpp_type == f.CPPTYPE_STRING
     ]
 
 
@@ -281,6 +301,18 @@ class GatewayMetrics:
             )
             for name in serving_gauge_names()
         }
+        # Mesh identity, info-style: value is always 1, the labels
+        # carry the strings (mesh_shape). Derived from the descriptor's
+        # string fields, like the gauges from its numeric ones.
+        self.serving_mesh_info = Gauge(
+            "gateway_backend_serving_mesh_info",
+            "Backend serving-mesh identity (labels carry the info; "
+            "join on target with the tp_chips / mesh_spec_downgrades "
+            "gauges)",
+            ["target", *serving_info_names()],
+            registry=self.registry,
+        )
+        self._mesh_info_labels: dict[str, tuple] = {}
         # True backend latency histograms (ttft/e2e/queue/tick
         # duration): pre-bucketed on the backend by the flight
         # recorder, re-exposed here with real `le` series so PromQL
@@ -364,6 +396,20 @@ class GatewayMetrics:
                 # strings and doubles as numbers — float() takes both,
                 # and the millisecond stall gauges carry fractions.
                 self._child(gauge, target).set(float(value))
+            info = tuple(
+                str(entry.get(_snake_to_camel(name), ""))
+                for name in serving_info_names()
+            )
+            prev = self._mesh_info_labels.get(target)
+            if prev is not None and prev != info:
+                # A backend's mesh identity changed (restart with a new
+                # topology): retire the stale label set or both export.
+                try:
+                    self.serving_mesh_info.remove(target, *prev)
+                except KeyError:
+                    pass
+            self._mesh_info_labels[target] = info
+            self.serving_mesh_info.labels(target, *info).set(1)
             self.serving_histograms.update(target, entry)
             for unit, key in (("requests", "queuedRequests"),
                               ("tokens", "queuedTokens")):
@@ -378,6 +424,12 @@ class GatewayMetrics:
                     pass
                 self._children.pop((id(gauge), target), None)
             self.serving_histograms.remove(target)
+            prev = self._mesh_info_labels.pop(target, None)
+            if prev is not None:
+                try:
+                    self.serving_mesh_info.remove(target, *prev)
+                except KeyError:
+                    pass
             for unit in ("requests", "tokens"):
                 try:
                     self.batcher_pending_depth.remove(target, unit)

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ggrmcp_tpu.utils.jax_compat import shard_map
+from ggrmcp_tpu.utils.jax_compat import pcast, shard_map
 
 from ggrmcp_tpu.ops.attention import NEG_INF, attention_xla
 
@@ -55,8 +55,9 @@ def _ring_local(
     l0 = jnp.zeros((b, h, sl, 1), jnp.float32)
     acc0 = jnp.zeros((b, sl, h, d), jnp.float32)
     # Mark the accumulators as varying over the ring axis so the scan
-    # carry types line up (shard_map varying-axis typing).
-    m0, l0, acc0 = jax.lax.pcast(
+    # carry types line up (shard_map varying-axis typing; identity on
+    # a jax without pcast — utils/jax_compat.py).
+    m0, l0, acc0 = pcast(
         (m0, l0, acc0), (axis_name,), to="varying"
     )
     perm = [(i, (i + 1) % n) for i in range(n)]

@@ -19,6 +19,24 @@ class SamplingConfig(NamedTuple):
     top_p: float = 1.0  # 1 → disabled
 
 
+def _invcdf_pick(u: jnp.ndarray, logits: jnp.ndarray) -> jnp.ndarray:
+    """Categorical draw by CDF inversion from a per-row SCALAR uniform:
+    token = #{i : cdf_i < u·mass}. Exactly the categorical distribution
+    — and, unlike jax.random.categorical over the [V] axis, MESH-
+    INVARIANT: categorical generates a [V]-shaped noise tensor whose
+    random-bit assignment follows the array's partitioning, so a
+    vocab-sharded logits row (column-parallel lm_head under tensor-
+    parallel serving) draws a DIFFERENT token than the same row
+    replicated. A scalar uniform per row is produced element-wise from
+    the row's key (threefry is positionally fixed for elementwise
+    shapes), so the draw is identical on 1 chip and any mesh
+    (tests/test_tp.py sampled-row identity)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    cdf = jnp.cumsum(probs, axis=-1)
+    mass = cdf[..., -1:]  # ~1.0; guards fp shortfall at the tail
+    return jnp.sum(cdf < u[..., None] * mass, axis=-1).astype(jnp.int32)
+
+
 def sample(
     logits: jnp.ndarray,  # [B, V]
     key: jax.Array,
@@ -32,7 +50,12 @@ def sample(
         logits = _mask_top_k(logits, cfg.top_k)
     if cfg.top_p < 1.0:
         logits = _mask_top_p(logits, cfg.top_p)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    # Per-row scalar uniforms + CDF inversion (mesh-invariant draw —
+    # see _invcdf_pick; folding the row index keeps rows independent).
+    rows = jnp.arange(logits.shape[0])
+    keys = jax.vmap(jax.random.fold_in, (None, 0))(key, rows)
+    u = jax.vmap(lambda k: jax.random.uniform(k, ()))(keys)
+    return _invcdf_pick(u, logits)
 
 
 def dynamic_support_mask(
@@ -121,11 +144,16 @@ def sample_dynamic(
     safe_temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = jnp.where(support, logits / safe_temp, -jnp.inf)
 
-    def row_sample(seed, row_logits):
+    def row_uniform(seed):
+        # One SCALAR uniform per row (elementwise threefry): the draw
+        # is identical whether the row's logits are replicated or
+        # vocab-sharded over a tensor mesh — jax.random.categorical's
+        # [V]-shaped noise is NOT (see _invcdf_pick).
         key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
-        return jax.random.categorical(key, row_logits)
+        return jax.random.uniform(key, ())
 
-    sampled = jax.vmap(row_sample)(seeds, scaled).astype(jnp.int32)
+    u = jax.vmap(row_uniform)(seeds)
+    sampled = _invcdf_pick(u, scaled)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)
 

@@ -116,11 +116,20 @@ def with_sharding_constraint(x, mesh: Mesh, *spec):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
-def compatible_spec(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
+def compatible_spec(
+    spec: P, shape: tuple[int, ...], mesh: Mesh, on_downgrade=None
+) -> P:
     """Drop spec axes whose mesh size doesn't divide the corresponding
     array dimension (e.g. batch=1 over data=2 → replicate that dim).
     Keeps small-shape paths (streaming batch 1, tiny tests) runnable on
-    big meshes without special-casing every call site."""
+    big meshes without special-casing every call site.
+
+    `on_downgrade(dim_index, entry, dim_size, axis_size)` is invoked for
+    every REAL downgrade — a named axis of product > 1 replaced by
+    replication. Silent downgrades are how a replicated-weights fallback
+    masquerades as tensor-parallel serving: the engine threads a counter
+    through here so every drop is logged at init and exported as the
+    `mesh_spec_downgrades` gauge (docs/tensor_parallel_serving.md)."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
 
     def axis_product(entry) -> int:
@@ -130,6 +139,27 @@ def compatible_spec(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
         return math.prod(sizes.get(n, 1) for n in names)
 
     out = []
-    for dim, entry in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
-        out.append(entry if dim % max(axis_product(entry), 1) == 0 else None)
+    for i, (dim, entry) in enumerate(
+        zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec)))
+    ):
+        product = max(axis_product(entry), 1)
+        if dim % product == 0:
+            out.append(entry)
+        else:
+            # product > 1 here by construction (dim % 1 == 0 always),
+            # so every drop is a genuine sharding loss.
+            if on_downgrade is not None:
+                on_downgrade(i, entry, dim, product)
+            out.append(None)
     return P(*out)
+
+
+def mesh_shape_str(mesh: Mesh) -> str:
+    """Human-readable mesh shape ("tensor=8", "data=2,tensor=4", or
+    "single" for one device) — the ServingStats `mesh_shape` label and
+    the bench artifact's mesh field."""
+    parts = [
+        f"{a}={s}" for a, s in zip(mesh.axis_names, mesh.devices.shape)
+        if s > 1
+    ]
+    return ",".join(parts) or "single"

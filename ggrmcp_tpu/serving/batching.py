@@ -217,8 +217,14 @@ class ContinuousBatcher:
     """Slot-based continuous batching over a shared KV cache."""
 
     # counter_stats() keys that aggregate by MAX across tiers, not sum
-    # (serving/tiered.py::TieredBatcher.stats).
-    MAX_STAT_KEYS = ("admit_ms_max",)
+    # (serving/tiered.py::TieredBatcher.stats). The mesh identity keys
+    # are engine-level facts every tier shares — max of identical
+    # values (strings included: mesh_shape) reports them once instead
+    # of summing a constant per tier.
+    MAX_STAT_KEYS = (
+        "admit_ms_max", "tp_chips", "mesh_devices", "mesh_shape",
+        "mesh_spec_downgrades",
+    )
 
     def __init__(
         self,
@@ -624,10 +630,23 @@ class ContinuousBatcher:
         map pages, finishes unmap them, and the next dispatch carries
         the new mapping. Replay after a tick failure re-MAPS this way
         too: the allocator state is rebuilt host-side and re-uploaded,
-        never re-derived from device buffers."""
+        never re-derived from device buffers.
+
+        The snapshot is device_put REPLICATED onto the engine's mesh
+        (tables are tiny int32; every chip gathers/scatters the
+        head-sharded page arena through its own copy) — a bare
+        jnp.asarray would land the table on device 0 only, forcing a
+        resharding transfer inside every tick and breaking cache-leaf
+        donation under tensor-parallel serving
+        (docs/tensor_parallel_serving.md)."""
         if self._paged and self._tables_dirty:
+            from jax.sharding import NamedSharding, PartitionSpec
+
             self.cache = self.cache._replace(
-                table=jnp.asarray(self.pages.tables)
+                table=jax.device_put(
+                    self.pages.tables,
+                    NamedSharding(self.engine.mesh, PartitionSpec()),
+                )
             )
             self._tables_dirty = False
 
@@ -2003,6 +2022,12 @@ class ContinuousBatcher:
         counters and slot flags, safe to read stale."""
         t = self.timing
         return {
+            # Mesh identity (docs/tensor_parallel_serving.md): the
+            # tensor-axis size, total devices, human-readable shape,
+            # and how many sharding specs compatible_spec downgraded to
+            # replication — 0 downgrades is what makes "TP serving" a
+            # verified claim instead of a config setting.
+            **self.engine.mesh_stats(),
             "active_slots": self._active_count(),
             "total_slots": len(self.slots),
             "queued_requests": self.pending.qsize(),

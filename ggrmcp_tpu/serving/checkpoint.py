@@ -48,3 +48,27 @@ def restore(path: str, like: Any = None, shardings: Any = None) -> Any:
         if shardings is not None:
             return ckptr.restore(path, shardings)
         return ckptr.restore(path)
+
+
+def restore_sharded(path: str, abstract: Any, specs: Any, mesh) -> Any:
+    """Restore a param pytree DIRECTLY onto a device mesh: each leaf's
+    target is a ShapeDtypeStruct carrying its NamedSharding (specs are
+    adapted via compatible_spec for non-dividing dims), so Orbax reads
+    each parameter's shards straight to their devices — no full-tensor
+    host staging, the weight-load posture tensor-parallel serving
+    requires (docs/tensor_parallel_serving.md). `abstract` is any
+    shape/dtype tree (e.g. jax.eval_shape of the initializer)."""
+    from jax.sharding import NamedSharding
+
+    from ggrmcp_tpu.parallel import mesh as mesh_mod
+
+    target = jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(
+            x.shape, x.dtype,
+            sharding=NamedSharding(
+                mesh, mesh_mod.compatible_spec(s, x.shape, mesh)
+            ),
+        ),
+        abstract, specs,
+    )
+    return restore(path, like=target)

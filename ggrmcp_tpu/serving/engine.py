@@ -66,25 +66,51 @@ def fit_request(
     return prompt, max_new
 
 
-def _adapt_specs(specs, shapes, mesh: Mesh):
+def _adapt_specs(specs, shapes, mesh: Mesh, observer=None):
     """Null out spec axes that don't divide the actual dims (vocab sizes
-    and tiny test models aren't always multiples of the mesh)."""
-    return jax.tree_util.tree_map(
-        lambda s, x: mesh_mod.compatible_spec(s, x.shape, mesh), specs, shapes
-    )
+    and tiny test models aren't always multiples of the mesh).
+    `observer(where, dim, entry, size, axis)` is called for every real
+    downgrade (a named axis replaced by replication) with the leaf's
+    tree path — the engine counts and logs these so a silently
+    replicated weight can never masquerade as TP serving."""
+    if observer is None:
+        return jax.tree_util.tree_map(
+            lambda s, x: mesh_mod.compatible_spec(s, x.shape, mesh),
+            specs, shapes,
+        )
+
+    def adapt(path, s, x):
+        where = jax.tree_util.keystr(path)
+        return mesh_mod.compatible_spec(
+            s, x.shape, mesh,
+            on_downgrade=lambda dim, entry, size, axis: observer(
+                where, dim, entry, size, axis
+            ),
+        )
+
+    return jax.tree_util.tree_map_with_path(adapt, specs, shapes)
 
 
-def _shard_params(params, specs, mesh: Mesh):
-    specs = _adapt_specs(specs, params, mesh)
+def _shard_params(params, specs, mesh: Mesh, observer=None):
+    specs = _adapt_specs(specs, params, mesh, observer=observer)
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )
 
 
-def _sharded_init(init_fn, specs, mesh: Mesh, key):
-    """jit the initializer with mesh-adapted output shardings."""
+def _sharded_init(init_fn, specs, mesh: Mesh, key, observer=None):
+    """jit the initializer with mesh-adapted output shardings.
+
+    CAVEAT (docs/tensor_parallel_serving.md): random bits generated
+    inside a jit whose output shards its LEADING dim (e.g. the
+    vocab-sharded embed) depend on the partitioning — random-INIT
+    weights are therefore NOT reproducible across mesh shapes.
+    Cross-mesh bit-identity claims must feed both engines the same
+    weights (a checkpoint, or one host-side init tree); init here is
+    for serving models whose values don't matter (warmup, synthetic
+    perf staging, the random-llama3-8b fallback on ONE mesh)."""
     shapes = jax.eval_shape(init_fn, key)
-    specs = _adapt_specs(specs, shapes, mesh)
+    specs = _adapt_specs(specs, shapes, mesh, observer=observer)
     with mesh:
         params = jax.jit(
             init_fn,
@@ -127,6 +153,14 @@ class GenerationEngine:
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh(
             self.serving.mesh
         )
+        # Sharding-downgrade accounting (tensor-parallel serving,
+        # docs/tensor_parallel_serving.md): every spec axis
+        # compatible_spec replaces with replication is counted and
+        # logged — the `mesh_spec_downgrades` ServingStats gauge — so a
+        # fallback to replicated weights is always observable, never a
+        # masquerade of TP serving.
+        self.spec_downgrades = 0
+        self._downgrades_seen: set = set()
         # The Pallas flash kernel is a custom call GSPMD cannot
         # partition. Single-device: auto-select (None). Multi-device
         # TPU meshes whose sharding the kernel CAN take manually
@@ -203,13 +237,17 @@ class GenerationEngine:
                     partial(self.fam.init_params, cfg=cfg),
                     param_specs, self.mesh,
                     jax.random.PRNGKey(seed),
+                    observer=self._note_downgrade,
                 )
                 logger.info(
                     "initialized %s: %.1fM params in %.1fs",
                     cfg.name, count_params(params) / 1e6, time.monotonic() - t0,
                 )
             else:
-                params = _shard_params(params, param_specs, self.mesh)
+                params = _shard_params(
+                    params, param_specs, self.mesh,
+                    observer=self._note_downgrade,
+                )
             if self.serving.quantize:
                 params = self._quantize_params(params)
         params = self._init_lora(params, seed)
@@ -234,6 +272,44 @@ class GenerationEngine:
             self._generate_impl, static_argnums=(3, 4)
         )
         self._init_speculative(seed)
+
+    def _note_downgrade(
+        self, where: str, dim: int, entry, size: int, axis: int
+    ) -> None:
+        """compatible_spec dropped a real sharding axis for `where` —
+        count it (the mesh_spec_downgrades gauge) and log it. The count
+        is per distinct (leaf, dim) SITE — cache builders re-run per
+        batcher/stream, and a per-call count would inflate an
+        unchanging condition into an ever-growing gauge."""
+        key = (where, dim)
+        if key not in self._downgrades_seen:
+            self._downgrades_seen.add(key)
+            self.spec_downgrades += 1
+            logger.warning(
+                "mesh spec downgrade: %s dim %d (size %d) not divisible "
+                "by mesh axis %r (size %d) — replicated instead of "
+                "sharded (watch gauge mesh_spec_downgrades)",
+                where or "<leaf>", dim, size, entry, axis,
+            )
+
+    def _observe_cache_spec(self, where, dim, entry, size, axis) -> None:
+        """compatible_spec observer for KV-cache layouts (batch-dim
+        drops on tiny test batches are expected; a KV-HEAD drop — GQA
+        heads not divisible by the tensor axis — is the one that turns
+        sharded attention into replicated attention)."""
+        self._note_downgrade(where, dim, entry, size, axis)
+
+    def mesh_stats(self) -> dict:
+        """Mesh identity for ServingStats / the bench artifact: tensor
+        chips, total devices, the human-readable shape, and how many
+        sharding specs were downgraded to replication (0 = every spec
+        landed as written — real TP serving)."""
+        return {
+            "tp_chips": mesh_mod.axis_size(self.mesh, "tensor"),
+            "mesh_devices": int(self.mesh.devices.size),
+            "mesh_shape": mesh_mod.mesh_shape_str(self.mesh),
+            "mesh_spec_downgrades": self.spec_downgrades,
+        }
 
     def _init_lora(self, params, seed: int):
         """Multi-LoRA serving (ops/lora.py): stack per-adapter factors
@@ -604,7 +680,9 @@ class GenerationEngine:
             ),
             jax.random.PRNGKey(seed),
         )
-        qspecs = _adapt_specs(qspecs, shapes, self.mesh)
+        qspecs = _adapt_specs(
+            qspecs, shapes, self.mesh, observer=self._note_downgrade
+        )
         leaves, treedef = jax.tree_util.tree_flatten(shapes)
 
         def gen(key):
@@ -658,7 +736,9 @@ class GenerationEngine:
         # exactly the bigger-than-slice targets PP serves.
         qspecs = quant.quantize_specs(self._param_specs)
         shapes = jax.eval_shape(quant.quantize_model, params)
-        qspecs = _adapt_specs(qspecs, shapes, self.mesh)
+        qspecs = _adapt_specs(
+            qspecs, shapes, self.mesh, observer=self._note_downgrade
+        )
         before = quant.quantized_nbytes(params)
         with self.mesh:
             # Donate the dense params: XLA frees each full-precision
@@ -779,9 +859,12 @@ class GenerationEngine:
             else fam.cache_specs()
         )
         scale_shape = kv_shape[:-1] + (1,)
+        observe = partial(self._observe_cache_spec, "kv_cache")
 
         def kv_spec(spec):
-            adapted = mesh_mod.compatible_spec(spec, kv_shape, self.mesh)
+            adapted = mesh_mod.compatible_spec(
+                spec, kv_shape, self.mesh, on_downgrade=observe
+            )
             if not self.kv_dtype:
                 return adapted
             # Quantized leaf: the scale tree mirrors the values
@@ -829,9 +912,12 @@ class GenerationEngine:
         )
         scale_shape = kv_shape[:-1] + (1,)
         raw = llama_mod.paged_cache_specs()
+        observe = partial(self._observe_cache_spec, "paged_kv_arena")
 
         def kv_spec(spec):
-            adapted = mesh_mod.compatible_spec(spec, kv_shape, self.mesh)
+            adapted = mesh_mod.compatible_spec(
+                spec, kv_shape, self.mesh, on_downgrade=observe
+            )
             if not self.kv_dtype:
                 return adapted
             return quant.QuantizedArray(

@@ -79,8 +79,45 @@ class ByteTokenizer:
         return ByteStreamDecoder(self.OFFSET)
 
 
+class HFStreamDecoder:
+    """Incremental decode for HFTokenizer id streams — the
+    ByteStreamDecoder contract (never emit a split multi-byte rune as
+    U+FFFD mid-stream) for subword vocabularies.
+
+    Llama-3's 128,256-token vocabulary is byte-level BPE: a token can
+    END mid-rune (the rest arrives in the next token), so decoding each
+    chunk independently would surface replacement characters for text
+    that is merely split. Tokens accumulate here and every feed()
+    re-decodes the stream, emitting only the STABLE prefix (trailing
+    U+FFFD held back as a probably-incomplete sequence); flush() emits
+    whatever remains — a genuinely dangling tail decodes with
+    replacement characters, exactly like ByteStreamDecoder.flush()."""
+
+    def __init__(self, tok: "HFTokenizer") -> None:
+        self._tok = tok
+        self._ids: list[int] = []
+        self._emitted = 0
+
+    def feed(self, ids: list[int]) -> str:
+        self._ids.extend(int(i) for i in ids)
+        text = self._tok.decode(self._ids)
+        stable = text.rstrip("�")
+        if len(stable) < self._emitted:
+            return ""
+        delta = stable[self._emitted:]
+        self._emitted = len(stable)
+        return delta
+
+    def flush(self) -> str:
+        text = self._tok.decode(self._ids)
+        delta = text[self._emitted:]
+        self._emitted = len(text)
+        return delta
+
+
 class HFTokenizer:
-    """Wrapper over a local tokenizers-library file."""
+    """Wrapper over a local tokenizers-library file (e.g. the Llama-3
+    128,256-vocab tokenizer.json via serving.tokenizer_path)."""
 
     def __init__(self, path: str):
         from tokenizers import Tokenizer as _Tok
@@ -104,8 +141,27 @@ class HFTokenizer:
     def decode(self, ids: list[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
 
+    def stream_decoder(self) -> HFStreamDecoder:
+        """Per-stream incremental decoder (GenerateStream text_delta
+        safety — same contract as ByteTokenizer.stream_decoder)."""
+        return HFStreamDecoder(self)
 
-def load_tokenizer(path: str = "") -> Tokenizer:
-    if path and os.path.exists(path):
+
+def load_tokenizer(path: str = "", strict: bool = True) -> Tokenizer:
+    """"" → the hermetic byte tokenizer. A non-empty path loads the HF
+    tokenizer.json — and a MISSING configured path is a loud error by
+    default: a sidecar silently serving byte-level tokens under a
+    config that names the Llama-3 tokenizer would mis-tokenize every
+    prompt while looking healthy (strict=False restores the old
+    fallback for best-effort callers)."""
+    if not path:
+        return ByteTokenizer()
+    if os.path.exists(path):
         return HFTokenizer(path)
+    if strict:
+        raise FileNotFoundError(
+            f"serving.tokenizer_path {path!r} does not exist "
+            f"(set it to a real tokenizer.json or clear it for the "
+            f"byte-level tokenizer)"
+        )
     return ByteTokenizer()

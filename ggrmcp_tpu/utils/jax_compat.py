@@ -10,6 +10,10 @@ into the container, so API moves are bridged here instead:
   over the whole mesh computes the same values (unnamed axes replicate
   instead of staying auto-partitioned; duplicated compute, identical
   outputs) — and check_vma maps back to check_rep.
+- `jax.lax.pcast` (varying-axis typing for shard_map carries) does not
+  exist on older jax: legacy shard_map has no varying-axis type system
+  to satisfy, so the shim is the identity there — values are computed
+  identically either way (the op only adjusts types, never data).
 """
 
 from __future__ import annotations
@@ -30,3 +34,14 @@ def shard_map(f, **kwargs):
         if "check_vma" in kwargs:
             kwargs["check_rep"] = kwargs.pop("check_vma")
     return _shard_map(f, **kwargs)
+
+
+def pcast(x, axes, to="varying"):
+    """jax.lax.pcast where it exists; identity on a jax without it
+    (pre-varying-axis shard_map — there is no type system to mark,
+    and pcast never changes values)."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to=to)
+    return x

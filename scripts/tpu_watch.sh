@@ -174,6 +174,36 @@ stage_8b() {
   have_bench bench_tpu_8b.json
 }
 
+# Tensor-parallel serving (ISSUE 7, docs/tensor_parallel_serving.md):
+# the flagship llama3-8b geometry with decode ticks sharded over ALL
+# chips (MeshConfig tensor=0 is the bench default), plus the TP A/B
+# phase (1-chip vs full-mesh engines → per-chip tokens/s + the
+# mesh_spec_downgrades gate). Runs only when the slice has >=2 chips
+# (a v5e-1 window can't measure TP; the stage records that and
+# passes). If the real 128,256-vocab Llama-3 tokenizer.json is on disk
+# (GGRMCP_LLAMA3_TOKENIZER or $ART/llama3-tokenizer.json), the sidecar
+# serves it and the artifact gains `tokenizer: llama3`.
+stage_8b_tp() {
+  note "stage llama3-8b TP: start"
+  local chips
+  chips=$(timeout 120 python -c 'import jax; print(len(jax.devices()))' 2>/dev/null || echo 0)
+  if [ "${chips:-0}" -lt 2 ]; then
+    note "stage llama3-8b TP: SKIPPED (single-chip slice; TP needs >=2)"
+    echo '{"skipped": "single-chip slice"}' > "$ART/bench_tpu_8b_tp.json"
+    return 0
+  fi
+  local tok="${GGRMCP_LLAMA3_TOKENIZER:-$ART/llama3-tokenizer.json}"
+  [ -f "$tok" ] || tok=""
+  GGRMCP_BENCH_MODEL=llama3-8b GGRMCP_BENCH_QUANT=int8 GGRMCP_BENCH_KV=int8 \
+    GGRMCP_BENCH_SYNTH=1 GGRMCP_BENCH_SESSIONS=16 GGRMCP_BENCH_CALLS=160 \
+    GGRMCP_BENCH_HEADLINE_ONLY=1 GGRMCP_BENCH_TP=on \
+    GGRMCP_BENCH_TOKENIZER="$tok" \
+    GGRMCP_BENCH_BUDGET_S=1500 timeout 1600 python bench.py 9>&- \
+    > "$ART/bench_tpu_8b_tp.json" 2> "$ART/bench_tpu_8b_tp.err"
+  note "stage llama3-8b TP: rc=$? on_chip=$(have_bench bench_tpu_8b_tp.json && echo yes || echo no)"
+  have_bench bench_tpu_8b_tp.json
+}
+
 # Tuned follow-ups (round 4): the first window's captures are
 # tunnel-RTT bound — ~220 ms per 8-step tick vs ~3.5 ms/step of
 # arithmetic — so doubling the fused steps per device call and
@@ -290,6 +320,7 @@ all_done() {
     && have_bench bench_tpu_tiny.json && have_bench bench_tpu.json \
     && have_attn && have_bench bench_tpu_int8.json \
     && have_bench bench_tpu_8b.json \
+    && [ -f "$ART/bench_tpu_8b_tp.json" ] \
     && have_bench bench_tpu_spec.json \
     && have_bench bench_tpu_int8_t16.json \
     && have_bench bench_tpu_8b_t16.json \
@@ -308,6 +339,10 @@ run_ladder() {
   have_attn                      || stage_attn || probe || return 1
   have_bench bench_tpu_int8.json || stage_int8 || probe || return 1
   have_bench bench_tpu_8b.json   || stage_8b   || probe || return 1
+  # TP is the round's flagship capture: right after the 8B baseline,
+  # before the rebank/tuning points (a >=2-chip window is rare enough
+  # that it must not wait behind them; skipped-markers pass through).
+  [ -f "$ART/bench_tpu_8b_tp.json" ] || stage_8b_tp || probe || return 1
   # Rebank BEFORE the tuning A/B: in a short late-round window the
   # fresh full-phase flagship capture (which feeds BENCH_r{N}) is
   # worth more than the tuning points.

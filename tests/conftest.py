@@ -20,6 +20,22 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compile cache for the suite: the tests build many
+# batchers/engines whose device programs are byte-identical HLO
+# (same tiny models, same shapes, same meshes) — the disk cache dedups
+# those compiles within a run and across runs, which is what keeps the
+# tier-1 wall clock inside its budget as the suite grows. Keyed on HLO,
+# so it can never change a test's numerics; JAX_COMPILATION_CACHE_DIR
+# in the environment overrides.
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "ggrmcp-test-xla-cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 try:

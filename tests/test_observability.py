@@ -98,16 +98,24 @@ class TestProtoDrift:
             GatewayMetrics,
             serving_gauge_names,
             serving_histogram_names,
+            serving_info_names,
         )
         from ggrmcp_tpu.rpc.pb import serving_pb2
 
         desc = serving_pb2.ServingStatsResponse.DESCRIPTOR
         gauges = set(serving_gauge_names())
         hists = set(serving_histogram_names())
+        infos = set(serving_info_names())
         assert hists == {"ttft_ms", "e2e_ms", "queue_ms", "tick_duration_ms"}
+        # String fields export info-style (labels carry the value) —
+        # mesh_shape is the first; a new string field lands there by
+        # construction.
+        assert infos == {"mesh_shape"}
+        assert not (gauges & infos)
         for field in desc.fields:
             covered = (
                 field.name in gauges
+                or field.name in infos
                 or any(
                     field.name in
                     (f"{h}_bucket", f"{h}_sum", f"{h}_count")
@@ -116,12 +124,26 @@ class TestProtoDrift:
                 or field.name == "latency_bucket_bounds_ms"
             )
             assert covered, f"ServingStats field {field.name} not exported"
+        # The TP-serving identity fields must stay exported as gauges —
+        # the anti-masquerade contract (docs/tensor_parallel_serving.md).
+        assert {"tp_chips", "mesh_devices", "mesh_spec_downgrades"} <= gauges
 
         metrics = GatewayMetrics()
         if metrics.registry is None:
             pytest.skip("prometheus_client unavailable")
-        # The registry actually carries a gauge per scalar field.
+        # The registry actually carries a gauge per scalar field, and
+        # the info series carries one label per string field.
         assert set(metrics.serving_gauges) == gauges
+        metrics.set_serving_stats([{
+            "target": "t1", "tpChips": 2, "meshShape": "tensor=2",
+        }])
+        rendered = metrics.render()[0].decode()
+        assert 'gateway_backend_serving_mesh_info{' in rendered
+        assert 'mesh_shape="tensor=2"' in rendered
+        assert 'gateway_backend_tp_chips{target="t1"} 2.0' in rendered
+        # Target disappears → the info series must retire with it.
+        metrics.set_serving_stats([])
+        assert 'mesh_shape="tensor=2"' not in metrics.render()[0].decode()
 
     def test_flight_recorder_stats_match_proto_fields(self):
         """histogram_stats() keys must be exact proto field names —

@@ -133,12 +133,82 @@ class TestHFTokenizer:
         assert (wrapped.pad_id, wrapped.bos_id, wrapped.eos_id) == (0, 1, 2)
 
 
+@pytest.fixture(scope="module")
+def hf_bytelevel_file(tmp_path_factory):
+    """A byte-level BPE tokenizer.json (the Llama-3 tokenizer's
+    scheme): tokens are byte sequences, so a token boundary CAN fall
+    inside a multi-byte rune — exactly the case the streaming decoder
+    must hold back."""
+    from tokenizers import Tokenizer, decoders, pre_tokenizers
+    from tokenizers.models import BPE
+    from tokenizers.trainers import BpeTrainer
+
+    tok = Tokenizer(BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = BpeTrainer(
+        vocab_size=300,
+        special_tokens=["<pad>", "<s>", "</s>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train_from_iterator(["the quick brown fox"] * 4, trainer)
+    path = tmp_path_factory.mktemp("bl") / "tokenizer.json"
+    tok.save(str(path))
+    return str(path)
+
+
+class TestHFStreamDecoder:
+    """The ByteStreamDecoder contract on the HF (subword) path — what
+    GenerateStream rides when serving.tokenizer_path names a real
+    tokenizer.json (Llama-3's byte-level BPE on the 128k vocab)."""
+
+    @pytest.mark.parametrize("size", [1, 2, 3])
+    def test_multibyte_runes_never_split(self, hf_bytelevel_file, size):
+        tok = HFTokenizer(hf_bytelevel_file)
+        for text in ("héllo wörld", "日本語テスト", "mix: é日x🎉y", "🎉🎉"):
+            ids = tok.encode(text)
+            dec = tok.stream_decoder()
+            out = ""
+            for i in range(0, len(ids), size):
+                piece = dec.feed(ids[i:i + size])
+                assert "�" not in piece, (text, size, i)
+                out += piece
+            assert out + dec.flush() == tok.decode(ids)
+
+    def test_incremental_matches_batch_decode(self, hf_bytelevel_file):
+        tok = HFTokenizer(hf_bytelevel_file)
+        text = "the quick brown fox 日本語 🎉"
+        ids = tok.encode(text)
+        dec = tok.stream_decoder()
+        streamed = "".join(dec.feed([i]) for i in ids) + dec.flush()
+        assert streamed == tok.decode(ids) == text
+
+    def test_incomplete_tail_held_until_completed(self, hf_bytelevel_file):
+        tok = HFTokenizer(hf_bytelevel_file)
+        ids = tok.encode("日")
+        if len(ids) < 2:
+            pytest.skip("tokenizer merged the rune into one token")
+        dec = tok.stream_decoder()
+        partial = dec.feed(ids[:1])
+        assert "�" not in partial
+        assert dec.feed(ids[1:]) + dec.flush() == "日"[len(partial):]
+
+
 class TestLoader:
     def test_default_is_byte_level(self):
         assert isinstance(load_tokenizer(""), ByteTokenizer)
 
-    def test_missing_path_falls_back(self):
-        assert isinstance(load_tokenizer("/nope/tokenizer.json"), ByteTokenizer)
+    def test_missing_path_is_loud_by_default(self):
+        """A config naming a tokenizer.json that is absent must fail at
+        startup, not silently serve byte-level tokens under a Llama-3
+        config (the TP-serving masquerade rule applied to tokenizers)."""
+        with pytest.raises(FileNotFoundError):
+            load_tokenizer("/nope/tokenizer.json")
+        assert isinstance(
+            load_tokenizer("/nope/tokenizer.json", strict=False),
+            ByteTokenizer,
+        )
 
     def test_existing_path_uses_hf(self, hf_tokenizer_file):
         assert isinstance(load_tokenizer(hf_tokenizer_file), HFTokenizer)
