@@ -6,8 +6,8 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: proto proto-check descriptors test test-all test-fast test-chaos \
-  test-obs test-grammar test-spec-batch test-paged test-tp bench-cpu \
-  smoke e2e lint ci-local preflight clean
+  test-obs test-grammar test-spec-batch test-paged test-tp test-analysis \
+  bench-cpu smoke e2e lint graftlint ci-local preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
 # No protoc on this image? scripts/regen_serving_pb2.py regenerates
@@ -105,6 +105,22 @@ smoke:
 # Real processes + curl through the live MCP surface (CI parity).
 e2e:
 	./scripts/e2e_smoke.sh
+
+# The JAX-aware static-analysis gate (ggrmcp_tpu/analysis): stdlib-ast
+# rules encoding the serving plane's shipped-bug invariants — sharded
+# sampling, unsharded transfers, alloc-in-jit, async hygiene,
+# proto<->metrics drift. Zero unsuppressed findings or rc!=0; pragma
+# policy + rule catalog in docs/static_analysis.md. Needs no deps
+# beyond the stdlib, so it runs anywhere (TPU image included).
+graftlint:
+	$(PY) -m ggrmcp_tpu.analysis
+
+# The graftlint net alone: fixture tests proving each rule fires (on
+# the historical pre-fix code shape), pragma mechanics, the
+# security-scan smoke, and the tree-wide self-enforcement test.
+# Tier-1 runs these too; this is the fast inner loop for rule work.
+test-analysis:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m analysis
 
 # ruff if present (baked CI image installs it; the TPU image may not).
 lint:

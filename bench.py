@@ -216,7 +216,7 @@ def _probe_device(attempts: int = 2, timeout_s: float = 120.0) -> bool:
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", code],
-                timeout=timeout_s, capture_output=True,
+                timeout=timeout_s, capture_output=True, check=False,
             )
         except subprocess.TimeoutExpired:
             print(
@@ -2006,7 +2006,7 @@ def _cpu_fallback(reason: str) -> None:
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
-            env=env, stdout=subprocess.PIPE, timeout=1200,
+            env=env, stdout=subprocess.PIPE, timeout=1200, check=False,
         )
         out = proc.stdout.decode(errors="replace").strip()
         if not out:

@@ -1,0 +1,13 @@
+"""Static analysis plane: graftlint, the JAX-aware lint gate that
+encodes the serving plane's hard invariants as stdlib-`ast` rules
+(each citing the shipped bug it would have caught — see
+docs/static_analysis.md and ggrmcp_tpu/analysis/rules.py).
+
+Deliberately importable WITHOUT jax/grpc installed so CI can run the
+gate before (or without) installing the serving dependencies — keep
+heavyweight imports out of this package.
+"""
+
+from ggrmcp_tpu.analysis.graftlint import Finding, Report, main, run
+
+__all__ = ["Finding", "Report", "main", "run"]

@@ -1,0 +1,8 @@
+"""`python -m ggrmcp_tpu.analysis` — run the graftlint gate."""
+
+import sys
+
+from ggrmcp_tpu.analysis.graftlint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

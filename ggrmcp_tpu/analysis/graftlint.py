@@ -1,0 +1,389 @@
+"""graftlint — the serving plane's JAX-aware static-analysis gate.
+
+The reference gates every PR on a dedicated static-analysis plane
+(golangci-lint + gosec + CodeQL, .golangci.yml / security.yml) and
+ruff.toml claims parity with it — but ruff's generic rule families
+cannot see the failure class that has actually shipped bugs HERE:
+
+* PR 7: jax.random.categorical's [V]-shaped noise follows the logits'
+  partitioning, so sampled rows drew DIFFERENT tokens on a
+  vocab-sharded tensor mesh (ops/sampling.py now inverts the CDF from
+  a per-row scalar uniform instead);
+* PR 7: a bare jnp.asarray landed paged block tables on device 0,
+  forcing a resharding transfer inside every tick and breaking cache
+  donation (serving/batching.py _sync_tables now device_puts them
+  replicated onto the mesh);
+* PR 6: page allocation is whole-lifetime at admission — PageAllocator
+  is HOST state, and nothing reachable from a jitted tick body may
+  allocate or mutate it;
+* PR 2: a broad `except Exception` swallowed the CancelledError aimed
+  at discovery.close() itself, wedging shutdown half-closed.
+
+Every one of those was a mechanically detectable pattern. graftlint
+encodes them as stdlib-`ast` rules (same hermetic, zero-dependency
+design as scripts/security_scan.py — importable without jax installed)
+so the invariants are enforced at lint time, not rediscovered one TPU
+window at a time.
+
+Suppression is explicit and auditable: an inline pragma
+
+    # graftlint: disable=<rule>[,<rule>...] -- <justification>
+
+on the flagged line (or standing alone on the line above it) suppresses
+the named rules THERE ONLY. The justification is mandatory — a pragma
+without one is itself a finding (`pragma-missing-justification`), and a
+pragma whose rule no longer fires on that line is reported as a cleanup
+candidate (`pragma-stale`). Meta findings cannot be pragma'd away.
+
+Entry points: `python -m ggrmcp_tpu.analysis`, `make graftlint`, a
+scripts/ci_local.py step, and the tier-1 self-enforcement test
+(tests/test_graftlint.py, marker `analysis`) that keeps the tree at
+zero unsuppressed findings. Rule catalog + pragma policy:
+docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass, field
+
+# Directories scanned by default, relative to the repo root. Generated
+# code is exempt wholesale (same stance as ruff's per-file-ignores).
+DEFAULT_DIRS = ("ggrmcp_tpu",)
+EXCLUDE_PARTS = {"__pycache__"}
+EXCLUDE_PREFIXES = ("ggrmcp_tpu/rpc/pb/",)
+
+# Pragma grammar. The justification after `--` is MANDATORY; rule ids
+# are kebab-case. (The marker string is assembled so this module's own
+# regex literal can never match itself during a self-scan.)
+_PRAGMA_MARKER = "graftlint:"
+PRAGMA_RE = re.compile(
+    r"#\s*" + _PRAGMA_MARKER
+    + r"\s*disable=([a-z][a-z0-9-]*(?:\s*,\s*[a-z][a-z0-9-]*)*)"
+    + r"\s*(?:--\s*(.*?))?\s*$"
+)
+
+META_MISSING = "pragma-missing-justification"
+META_STALE = "pragma-stale"
+META_UNKNOWN = "pragma-unknown-rule"
+META_RULES = (META_MISSING, META_STALE, META_UNKNOWN)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+    precedent: str = ""
+
+    def fmt(self, *, cite: bool = True) -> str:
+        out = f"[{self.rule}] {self.path}:{self.line}  {self.message}"
+        if cite and self.precedent:
+            out += f"\n    precedent: {self.precedent}"
+        return out
+
+
+@dataclass
+class Pragma:
+    path: str
+    line: int  # the pragma comment's own line
+    covers: int  # the source line it suppresses findings on
+    rules: tuple[str, ...]
+    justification: str
+    used: set = field(default_factory=set)  # rule ids that matched
+
+
+@dataclass
+class Module:
+    path: pathlib.Path
+    rel: str
+    source: str
+    tree: ast.AST
+
+
+@dataclass
+class Report:
+    findings: list  # unsuppressed Findings (meta findings included)
+    suppressed: list  # (Finding, Pragma) pairs
+    parse_errors: list  # (rel, message)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+    def render(self, *, show_suppressed: bool = False) -> str:
+        lines: list[str] = []
+        for rel, msg in self.parse_errors:
+            lines.append(f"[parse-error] {rel}: {msg}")
+        for f in self.findings:
+            lines.append(f.fmt())
+        if show_suppressed and self.suppressed:
+            lines.append("")
+            lines.append("-- suppressed by pragma --")
+            for f, p in self.suppressed:
+                lines.append(
+                    f.fmt(cite=False) + f"\n    justified: {p.justification}"
+                )
+        lines.append(
+            f"graftlint: {len(self.findings)} unsuppressed finding(s), "
+            f"{len(self.suppressed)} suppressed by pragma"
+        )
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------
+# Shared AST helpers
+# ---------------------------------------------------------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of the called object, best-effort ('' if dynamic)."""
+    parts: list[str] = []
+    f = node.func
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def keyword(node: ast.Call, name: str):
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def scoped_walk(node: ast.AST, *, into_defs: bool = False):
+    """Yield descendants of `node` without crossing into nested
+    function/class definitions (unless into_defs) — the unit of scoping
+    every rule here reasons about. Lambdas are always descended: their
+    bodies execute in the enclosing trace/coroutine context."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not into_defs and isinstance(
+            child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def exception_names(handler_type) -> list[str]:
+    """Terminal names of an except clause's type expression: 'Exception'
+    for `except Exception`, ['RpcError', 'CancelledError'] for a tuple,
+    'CancelledError' for `except asyncio.CancelledError`."""
+    if handler_type is None:
+        return ["<bare>"]
+    nodes = (
+        handler_type.elts
+        if isinstance(handler_type, ast.Tuple)
+        else [handler_type]
+    )
+    names = []
+    for n in nodes:
+        if isinstance(n, ast.Attribute):
+            names.append(n.attr)
+        elif isinstance(n, ast.Name):
+            names.append(n.id)
+    return names
+
+
+# ---------------------------------------------------------------------
+# Rule base + registry
+# ---------------------------------------------------------------------
+
+
+class Rule:
+    """One rule family. Subclasses set `id`, `title`, `precedent` and
+    implement `check(module)`; project-wide rules (cross-file contracts)
+    implement `check_project(root)` instead."""
+
+    id = ""
+    title = ""
+    precedent = ""
+
+    def applies_to(self, rel: str) -> bool:
+        return True
+
+    def check(self, module: Module):
+        return ()
+
+    def check_project(self, root: pathlib.Path):
+        return ()
+
+    def finding(self, rel: str, line: int, message: str) -> Finding:
+        return Finding(self.id, rel, line, message, self.precedent)
+
+
+def iter_modules(root: pathlib.Path, dirs=DEFAULT_DIRS):
+    files: list[pathlib.Path] = []
+    for d in dirs:
+        base = root / d
+        if base.is_file():
+            files.append(base)
+        elif base.is_dir():
+            files.extend(sorted(base.rglob("*.py")))
+    for path in files:
+        rel = path.relative_to(root).as_posix()
+        if any(part in EXCLUDE_PARTS for part in path.parts):
+            continue
+        if any(rel.startswith(p) for p in EXCLUDE_PREFIXES):
+            continue
+        yield path, rel
+
+
+def collect_pragmas(rel: str, source: str) -> list[Pragma]:
+    pragmas: list[Pragma] = []
+    lines = source.splitlines()
+    for i, raw in enumerate(lines, start=1):
+        m = PRAGMA_RE.search(raw)
+        if m is None:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(","))
+        justification = (m.group(2) or "").strip()
+        covers = i
+        if raw[: m.start()].strip() == "":
+            # Standalone pragma comment: covers the next non-blank,
+            # non-comment source line.
+            covers = 0
+            for j in range(i, len(lines)):
+                nxt = lines[j].strip()
+                if nxt and not nxt.startswith("#"):
+                    covers = j + 1
+                    break
+        pragmas.append(Pragma(rel, i, covers, rules, justification))
+    return pragmas
+
+
+def run(
+    root,
+    dirs=DEFAULT_DIRS,
+    rules=None,
+) -> Report:
+    """Analyze the tree under `root` and return the report. `rules`
+    defaults to the full registry (ggrmcp_tpu.analysis.rules)."""
+    root = pathlib.Path(root).resolve()
+    if rules is None:
+        from ggrmcp_tpu.analysis.rules import ALL_RULES
+
+        rules = ALL_RULES
+    known_ids = {r.id for r in rules}
+
+    raw_findings: list[Finding] = []
+    pragmas: list[Pragma] = []
+    parse_errors: list[tuple[str, str]] = []
+
+    for path, rel in iter_modules(root, dirs):
+        source = path.read_text()
+        try:
+            tree = ast.parse(source, filename=rel)
+        except SyntaxError as exc:  # unparseable source gates outright
+            parse_errors.append((rel, f"syntax error at line {exc.lineno}"))
+            continue
+        module = Module(path, rel, source, tree)
+        pragmas.extend(collect_pragmas(rel, source))
+        for rule in rules:
+            if rule.applies_to(rel):
+                raw_findings.extend(rule.check(module))
+
+    for rule in rules:
+        raw_findings.extend(rule.check_project(root))
+
+    # Apply pragmas: a finding is suppressed when a pragma for its rule
+    # covers its line in its file.
+    by_site: dict[tuple[str, int], list[Pragma]] = {}
+    for p in pragmas:
+        by_site.setdefault((p.path, p.covers), []).append(p)
+
+    findings: list[Finding] = []
+    suppressed: list[tuple[Finding, Pragma]] = []
+    for f in raw_findings:
+        hit = None
+        for p in by_site.get((f.path, f.line), ()):
+            if f.rule in p.rules:
+                p.used.add(f.rule)
+                hit = p
+                break
+        if hit is not None:
+            suppressed.append((f, hit))
+        else:
+            findings.append(f)
+
+    # Meta findings: the pragma mechanism polices itself. These are not
+    # suppressible — a pragma that needs a pragma is a process smell.
+    for p in pragmas:
+        for rid in p.rules:
+            if rid not in known_ids:
+                findings.append(Finding(
+                    META_UNKNOWN, p.path, p.line,
+                    f"pragma disables unknown rule '{rid}' "
+                    f"(known: {', '.join(sorted(known_ids))})",
+                ))
+            elif rid not in p.used:
+                findings.append(Finding(
+                    META_STALE, p.path, p.line,
+                    f"stale pragma: rule '{rid}' no longer fires on "
+                    f"line {p.covers} — remove the pragma "
+                    "(cleanup candidate)",
+                ))
+        if not p.justification:
+            findings.append(Finding(
+                META_MISSING, p.path, p.line,
+                "pragma without a justification — append "
+                "'-- <why this site is exempt>'",
+            ))
+
+    order = {r.id: i for i, r in enumerate(rules)}
+    findings.sort(key=lambda f: (order.get(f.rule, 99), f.path, f.line))
+    return Report(findings, suppressed, parse_errors)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from ggrmcp_tpu.analysis.rules import ALL_RULES
+
+    parser = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root (default: the checkout containing this package)",
+    )
+    parser.add_argument(
+        "--dirs", nargs="*", default=list(DEFAULT_DIRS),
+        help="directories under root to scan (default: ggrmcp_tpu)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog with cited precedents and exit",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print pragma-suppressed findings with justifications",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id}: {rule.title}")
+            print(f"    precedent: {rule.precedent}")
+        for rid in META_RULES:
+            print(f"{rid}: pragma self-policing (not suppressible)")
+        return 0
+
+    root = pathlib.Path(
+        args.root
+        if args.root is not None
+        else pathlib.Path(__file__).resolve().parents[2]
+    )
+    report = run(root, dirs=tuple(args.dirs))
+    print(report.render(show_suppressed=args.show_suppressed))
+    return 0 if report.clean else 1

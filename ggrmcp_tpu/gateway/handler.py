@@ -279,6 +279,8 @@ class MCPHandler:
                 session,
                 trace_id,
             )
+        except asyncio.CancelledError:
+            raise  # a cancelled request must not become a JSON error
         except Exception as exc:  # unexpected → internal error, sanitized
             logger.exception("internal error handling %s", method)
             self.metrics.observe_rpc(method, "internal_error")
@@ -535,6 +537,8 @@ class MCPHandler:
             final = mcp.make_response(
                 request_id, mcp.tool_call_error(sanitize_error(message))
             )
+        except asyncio.CancelledError:
+            raise  # client went away mid-stream; don't fabricate a chunk
         except Exception as exc:
             outcome = "internal_error"
             final = mcp.make_error_response(

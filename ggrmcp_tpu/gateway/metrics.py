@@ -55,6 +55,20 @@ _SERVING_HELP = {
     "decode_steps": "fused decode steps issued",
     "speculative_calls": "speculative device calls",
     "speculative_requests": "requests served speculatively",
+    "speculative_drafted": "side micro-batcher draft tokens proposed",
+    "speculative_accepted": "side micro-batcher draft tokens accepted",
+    "ticks": "decode ticks dispatched",
+    "tick_collects": "decode tick token collects",
+    "admit_rounds": "admission rounds run",
+    "tick_dispatch_ms": "cumulative host-side tick launch time (ms)",
+    "tick_collect_ms":
+        "cumulative blocking token-pull time (device wait + transfer, ms)",
+    "admit_ms": "cumulative admission-round wall time (ms)",
+    "admit_ms_max": "worst single admission round (ms)",
+    "queue_ms_p50": "median admission-queue wait, recent requests (ms)",
+    "queue_ms_p99": "p99 admission-queue wait, recent requests (ms)",
+    "service_ms_p50": "median on-device service time, recent requests (ms)",
+    "service_ms_p99": "p99 on-device service time, recent requests (ms)",
     "spec_ticks": "continuous-batcher speculative draft/verify ticks",
     "spec_drafted": "draft tokens proposed by the spec tick",
     "spec_accepted": "draft tokens accepted by the spec tick",
@@ -65,6 +79,8 @@ _SERVING_HELP = {
         "median gap between a live slot's token emissions",
     "decode_stall_ms_p99":
         "p99 gap between a live slot's token emissions",
+    "decode_stall_ms_max":
+        "worst gap between a live slot's token emissions",
     "queued_tokens": "prompt tokens held by queued requests",
     "timed_out": "requests expired in queue past queue_deadline_ms",
     "shed_requests":

@@ -40,7 +40,7 @@ def init_lora_layers(key, cfg, num_adapters: int, rank: int) -> dict:
     l, d = cfg.num_layers, cfg.hidden_dim
     qkv_out = (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
     dtype = cfg.jnp_dtype
-    a = 0.02 * jax.random.normal(
+    a = 0.02 * jax.random.normal(  # graftlint: disable=sharded-sampling -- one-time HOST-side weight init (outside jit): the bits are computed unsharded and identically on any mesh; the rule targets per-token decode-path noise whose sharding follows the logits
         key, (l, num_adapters + 1, d, rank), dtype
     )
     a = a.at[:, 0].set(0.0)  # the base row stays an exact no-op

@@ -98,6 +98,7 @@ def speculative_generate(
             """Per-row: temperature sample (Gumbel trick) where
             sampled, argmax where greedy."""
             g = jax.vmap(
+                # graftlint: disable=sharded-sampling -- side micro-batcher (batching.speculative=off fallback): the [V]-shaped Gumbel draw is distributionally exact on any mesh; cross-mesh bit-identity is only claimed for the continuous-batcher path (ops/sampling CDF inversion), and converting this would invalidate every recorded seeded artifact for zero distributional gain
                 lambda k: jax.random.gumbel(k, (logits.shape[-1],))
             )(keys)
             samp = jnp.argmax(logits / safe_t + g, axis=-1)
@@ -225,9 +226,10 @@ def speculative_generate(
             vlogp = jax.nn.log_softmax(
                 vlogits / safe_t[:, :, None], axis=-1
             )  # [B, gamma+1, V]
-            u = jax.vmap(lambda k: jax.random.uniform(k, (gamma,)))(
-                _fold(rk, 700)
-            )
+            u = jax.vmap(
+                # graftlint: disable=sharded-sampling -- [gamma]-shaped accept uniforms: no sharding spec ever maps a mesh axis to the gamma dim, so the draw is replicated and bit-identical on any mesh (the hazard is vocab-shaped noise)
+                lambda k: jax.random.uniform(k, (gamma,))
+            )(_fold(rk, 700))
             logp_x = jnp.take_along_axis(
                 vlogp[:, :gamma], proposals[:, :, None], axis=2
             )[:, :, 0]
@@ -259,6 +261,7 @@ def speculative_generate(
                 (a == gamma)[:, None], jnp.exp(p_a), resid
             )
             g2 = jax.vmap(
+                # graftlint: disable=sharded-sampling -- [V]-shaped residual draw of a lossless rejection sampler: the emitted distribution is exact on any mesh; bit-level cross-mesh identity is only claimed for greedy rows, which never reach this draw
                 lambda k: jax.random.gumbel(k, (resid.shape[-1],))
             )(_fold(rk, 900))
             samp_corr = jnp.argmax(
@@ -414,9 +417,10 @@ def spec_tick(
             g_allow[state], logits.astype(jnp.float32), -jnp.inf
         )
         qlogp = filtered_logprobs(masked, temps, ks, ps)
-        g = jax.vmap(lambda k: jax.random.gumbel(k, (masked.shape[-1],)))(
-            fold(tag)
-        )
+        g = jax.vmap(
+            # graftlint: disable=sharded-sampling -- draft PROPOSAL noise: rejection sampling is lossless for ANY q draw, so mesh-variance here shifts only the acceptance rate, never the emitted distribution; greedy rows bypass it entirely (test_tp spec bit-identity)
+            lambda k: jax.random.gumbel(k, (masked.shape[-1],))
+        )(fold(tag))
         samp = jnp.argmax(qlogp + g, axis=-1)
         return (
             jnp.where(sampled, samp, jnp.argmax(masked, axis=-1))
@@ -471,7 +475,10 @@ def spec_tick(
         in_axes=1, out_axes=1,
     )(vmasked)  # [B, gamma+1, V]
 
-    u = jax.vmap(lambda k: jax.random.uniform(k, (gamma,)))(fold(700))
+    u = jax.vmap(
+        # graftlint: disable=sharded-sampling -- [gamma]-shaped accept uniforms: no sharding spec ever maps a mesh axis to the gamma dim, so the draw is replicated and bit-identical on any mesh (the hazard is vocab-shaped noise)
+        lambda k: jax.random.uniform(k, (gamma,))
+    )(fold(700))
     logp_x = jnp.take_along_axis(
         plogp[:, :gamma], proposals[:, :, None], axis=2
     )[:, :, 0]
@@ -504,9 +511,10 @@ def spec_tick(
     resid = jnp.where(
         resid.sum(axis=-1, keepdims=True) > 1e-12, resid, jnp.exp(p_a)
     )
-    g2 = jax.vmap(lambda k: jax.random.gumbel(k, (resid.shape[-1],)))(
-        fold(900)
-    )
+    g2 = jax.vmap(
+        # graftlint: disable=sharded-sampling -- [V]-shaped residual draw of a lossless rejection sampler: the emitted distribution is exact on any mesh; bit-level cross-mesh identity is only claimed for greedy rows, which never reach this draw
+        lambda k: jax.random.gumbel(k, (resid.shape[-1],))
+    )(fold(900))
     corr_samp = jnp.argmax(jnp.log(resid + 1e-30) + g2, axis=-1).astype(
         jnp.int32
     )

@@ -182,6 +182,8 @@ class ServiceDiscoverer:
                 continue
             try:
                 methods = await backend.discover()
+            except asyncio.CancelledError:
+                raise  # a cancelled rebuild must not half-populate
             except Exception as exc:
                 logger.warning("discovery failed for %s: %s", backend.target, exc)
                 continue
@@ -307,6 +309,8 @@ class ServiceDiscoverer:
                 failpoints.evaluate("reconnect_fail")
                 await backend.connect()
                 return True
+            except asyncio.CancelledError:
+                raise  # cancellation outranks the retry budget
             except Exception as exc:
                 logger.warning(
                     "reconnect %s attempt %d/%d failed: %s",
@@ -422,6 +426,8 @@ class ServiceDiscoverer:
                     mi, arguments, None, timeout_s
                 )
                 return {"target": backend.target, **out}
+            except asyncio.CancelledError:
+                raise  # the gather owns cancellation, not the entry
             except Exception as exc:  # noqa: BLE001 — diagnostics only
                 return {"target": backend.target, "error": str(exc)}
 
@@ -452,6 +458,8 @@ class ServiceDiscoverer:
             try:
                 out = await backend.invoker.invoke(mi, {}, None, timeout_s)
                 return {"target": backend.target, **out}
+            except asyncio.CancelledError:
+                raise  # the gather owns cancellation, not the entry
             except Exception as exc:  # noqa: BLE001 — diagnostics only
                 return {"target": backend.target, "error": str(exc)}
 
@@ -489,6 +497,8 @@ class ServiceDiscoverer:
                 try:
                     stats = await self.get_backend_serving_stats()
                     self._serving_stats_cache = stats
+                except asyncio.CancelledError:
+                    raise  # close() cancels this task; let it die clean
                 except Exception as exc:  # noqa: BLE001
                     # Keep the stale snapshot but still stamp the time:
                     # a failing backend must back off for max_age_s, not
@@ -503,6 +513,8 @@ class ServiceDiscoverer:
                 await asyncio.wait_for(
                     asyncio.shield(self._serving_stats_task), first_wait_s
                 )
+            except asyncio.CancelledError:
+                raise  # the SCRAPE was cancelled (shield guards the task)
             except Exception:  # noqa: BLE001
                 pass  # scrape must never fail on a slow backend
         return list(self._serving_stats_cache)

@@ -150,6 +150,8 @@ class ReflectionClient:
         try:
             await self.list_services()
             return True
+        except asyncio.CancelledError:
+            raise  # cancellation is not "unhealthy"
         except Exception:
             return False
 

@@ -650,6 +650,20 @@ class ContinuousBatcher:
             )
             self._tables_dirty = False
 
+    def _snap_dev(self, x):
+        """Host→device snapshot of per-slot tick state (cur/prev
+        tokens, grammar states, grammar tables), device_put REPLICATED
+        onto the engine's mesh — the same contract as _sync_tables'
+        block tables. A bare jnp.asarray commits the snapshot to
+        device 0, which forces a resharding transfer inside every tick
+        under tensor-parallel serving (graftlint unsharded-transfer,
+        the PR 7 block-table bug generalized)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(
+            np.asarray(x), NamedSharding(self.engine.mesh, PartitionSpec())
+        )
+
     def _paged_put(self, cache, mini, slots, true_len, start):
         """Paged counterpart of every row merge (_merge_row, the
         full-pool select, the chunked-finish scatter): write mini rows'
@@ -699,8 +713,8 @@ class ContinuousBatcher:
             or self._g_dev_version != self.arena.version
         ):
             allow, trans, version = self.arena.snapshot()
-            self._g_allow_dev = jnp.asarray(allow)
-            self._g_trans_dev = jnp.asarray(trans)
+            self._g_allow_dev = self._snap_dev(allow)
+            self._g_trans_dev = self._snap_dev(trans)
             self._g_dev_version = version
         return self._g_allow_dev, self._g_trans_dev
 
@@ -2123,6 +2137,8 @@ class ContinuousBatcher:
                         await loop.run_in_executor(
                             None, self._drain_inflight
                         )
+                    except asyncio.CancelledError:
+                        raise  # batcher shutdown cancels the loop task
                     except Exception:
                         logger.exception("in-flight tick drain failed")
                         self._recover_after_tick_failure()
@@ -2138,13 +2154,15 @@ class ContinuousBatcher:
             # One batched decode tick (device-bound → executor).
             try:
                 await loop.run_in_executor(None, self._tick_step)
+            except asyncio.CancelledError:
+                raise  # batcher shutdown cancels the loop task
             except Exception:
                 # Replay every victim with budget left rather than
                 # failing the whole pool for one transient fault; the
                 # loop stays alive for future submissions either way.
                 logger.exception("decode tick failed; replaying active slots")
                 self._recover_after_tick_failure()
-            await asyncio.sleep(0)  # let handlers drain queues
+            await asyncio.sleep(0)  # noqa: ASYNC115 — deliberate yield so handlers drain queues (asyncio has no checkpoint())
 
     def _drain_inflight(self) -> None:
         while self._inflight:
@@ -2366,6 +2384,8 @@ class ContinuousBatcher:
                 await loop.run_in_executor(
                     None, self._prefill_into_slots, slots_idx, batch
                 )
+            except asyncio.CancelledError:
+                raise  # batcher shutdown cancels the loop task
             except Exception:
                 # Fail the batch, but scale the blast radius to what
                 # actually broke. Requests from this batch that already
@@ -2918,9 +2938,9 @@ class ContinuousBatcher:
         active = np.array([s.active for s in self.slots], bool)
         rec = self._tick_record(active)
         if self._cur_dev is None:
-            self._cur_dev = jnp.asarray(self.cur_tokens)
+            self._cur_dev = self._snap_dev(self.cur_tokens)
         if self._gstate_dev is None:
-            self._gstate_dev = jnp.asarray(self.gstates)
+            self._gstate_dev = self._snap_dev(self.gstates)
         g_allow, g_trans = self._grammar_tables()
         toks, self.cache, gstate_out = self._tick(
             self.engine.params, self._cur_dev, self.cache,
@@ -2968,11 +2988,11 @@ class ContinuousBatcher:
         self.step_counter += self._gamma + 1
         active = np.array([s.active for s in self.slots], bool)
         if self._cur_dev is None:
-            self._cur_dev = jnp.asarray(self.cur_tokens)
+            self._cur_dev = self._snap_dev(self.cur_tokens)
         if self._prev_dev is None:
-            self._prev_dev = jnp.asarray(self.prev_tokens)
+            self._prev_dev = self._snap_dev(self.prev_tokens)
         if self._gstate_dev is None:
-            self._gstate_dev = jnp.asarray(self.gstates)
+            self._gstate_dev = self._snap_dev(self.gstates)
         g_allow, g_trans = self._grammar_tables()
         args = (
             self.engine.params, self.engine.draft_params,
@@ -3075,13 +3095,13 @@ class ContinuousBatcher:
         self.step_counter += self._steps_per_tick
         active = np.array([s.active for s in self.slots], bool)
         if self._cur_dev is None:
-            self._cur_dev = jnp.asarray(self.cur_tokens)
+            self._cur_dev = self._snap_dev(self.cur_tokens)
         if self._ilv_mini is None:
             self._ilv_mini = self._make_mini(self._ilv_k, self.max_seq)
         chunk, offs, c_tl, c_valid, c_adapt = self._ilv_chunk_inputs()
         rec = self._tick_record(active, ilv_rows=int(c_valid.sum()))
         if self._gstate_dev is None:
-            self._gstate_dev = jnp.asarray(self.gstates)
+            self._gstate_dev = self._snap_dev(self.gstates)
         g_allow, g_trans = self._grammar_tables()
         toks, self.cache, self._ilv_mini, sel, gstate_out = self._tick_chunk(
             self.engine.params, self._cur_dev, self.cache,
@@ -3185,8 +3205,8 @@ class ContinuousBatcher:
             return
         finished_reason = None
         ids: list[int] = []
-        for token in tokens:
-            token = int(token)
+        for raw_token in tokens:
+            token = int(raw_token)
             if token == self.eos_id:
                 # Under a grammar, EOS is only sampleable in accepting
                 # DFA states — the output is complete valid JSON.

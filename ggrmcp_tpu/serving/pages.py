@@ -297,8 +297,8 @@ class PageAllocator:
         indexed page is kept: any surviving sharer was admitted by a
         call that already materialized its content)."""
         row = self.tables[slot]
-        for page in row[row != self.sentinel]:
-            page = int(page)
+        for mapped in row[row != self.sentinel]:
+            page = int(mapped)
             self._ref[page] -= 1
             if self._ref[page] == 0:
                 if page in self._key_of and discard_index:

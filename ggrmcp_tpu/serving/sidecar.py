@@ -358,6 +358,8 @@ class Sidecar:
                         seed=seed, trace_id=trace_id,
                     )
                     span.set(**stats)
+                except asyncio.CancelledError:
+                    raise  # client disconnect must cancel, not "error"
                 except Exception:
                     logger.exception("speculative generation failed")
                     finish = "error"
@@ -614,6 +616,8 @@ class Sidecar:
                 path = await loop.run_in_executor(
                     None, lambda: tracing.profile_capture(duration_ms, out)
                 )
+            except asyncio.CancelledError:
+                raise  # a cancelled RPC must not abort() a dead context
             except Exception as exc:
                 logger.exception("profile capture failed")
                 await context.abort(

@@ -434,7 +434,7 @@ def load_hf_checkpoint_sharded(
         jax.block_until_ready(params)
     finally:
         reader.close()
-    global last_load_stats
+    global last_load_stats  # noqa: PLW0603 — module-level load-stats export, read by sidecar/bench after every checkpoint load
     last_load_stats = {
         "weight_load_s": round(time.monotonic() - t0, 2),
         "weight_load_bytes_read": reader.bytes_read,

@@ -149,8 +149,8 @@ def parse_spec(spec: str) -> list[tuple[str, dict]]:
     recent `name:`-prefixed segment."""
     out: list[tuple[str, dict]] = []
     current: Optional[tuple[str, dict]] = None
-    for segment in spec.split(","):
-        segment = segment.strip()
+    for raw_segment in spec.split(","):
+        segment = raw_segment.strip()
         if not segment:
             continue
         if ":" in segment:

@@ -40,6 +40,11 @@ STEPS: list[tuple[str, list[str], dict[str, str]]] = [
     ),
     ("lint", ["make", "lint"], {}),
     (
+        "graftlint (JAX-aware invariant gate, ggrmcp_tpu/analysis)",
+        [sys.executable, "-m", "ggrmcp_tpu.analysis"],
+        {},
+    ),
+    (
         "security-scan (gosec/bandit + nancy/pip-audit analogue)",
         [sys.executable, "scripts/security_scan.py"],
         {},

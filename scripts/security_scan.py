@@ -206,17 +206,22 @@ class Scanner(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def scan_tree() -> list[Finding]:
+def scan_tree(root: pathlib.Path = ROOT) -> list[Finding]:
+    """Static-scan every first-party source under `root`. Parameterized
+    so the tier-1 smoke test (tests/test_graftlint.py) can run the real
+    scanner over a fixture tree with a planted HIGH finding and assert
+    the gate actually trips — the scanner itself must not silently rot."""
     findings: list[Finding] = []
     files: list[pathlib.Path] = []
     for d in SCAN_DIRS:
-        files.extend(sorted((ROOT / d).rglob("*.py")))
-    files.extend(ROOT / f for f in SCAN_FILES)
+        if (root / d).is_dir():
+            files.extend(sorted((root / d).rglob("*.py")))
+    files.extend(root / f for f in SCAN_FILES)
     self_path = pathlib.Path(__file__).resolve()
     for path in files:
         if not path.exists() or path.resolve() == self_path:
             continue  # the rule literals would flag themselves
-        rel = str(path.relative_to(ROOT))
+        rel = str(path.relative_to(root))
         is_test = rel.startswith("tests/")
         try:
             tree = ast.parse(path.read_text(), filename=rel)
@@ -289,9 +294,19 @@ def main() -> int:
         "install the project deps, where it would be all noise); "
         "the full run is scripts/ci_local.py's",
     )
+    parser.add_argument(
+        "--root", default=None,
+        help="scan an alternate tree (fixture smoke tests); the "
+        "dependency audit only makes sense on the real checkout, so "
+        "--root implies --static-only",
+    )
     args = parser.parse_args()
     static_only = args.static_only
-    findings = scan_tree()
+    root = ROOT
+    if args.root is not None:
+        root = pathlib.Path(args.root).resolve()
+        static_only = True
+    findings = scan_tree(root)
     order = {"HIGH": 0, "MEDIUM": 1, "LOW": 2}
     findings.sort(key=lambda f: (order[f.severity], f.path, f.line))
     high = [f for f in findings if f.severity == "HIGH"]
