@@ -1117,11 +1117,25 @@ async def _run_bench() -> dict:
             n = sb.get(count_key, 0)
             return round(sb.get(total_key, 0.0) / n, 2) if n else 0.0
 
+        from ggrmcp_tpu.serving.flight_recorder import PHASE_NAMES
+
         ticktime = {
             "ticks": sb.get("ticks", 0),
             "decode_steps_per_tick": tick_steps,
             "tick_dispatch_ms_avg": avg("tick_dispatch_ms", "ticks"),
             "tick_collect_ms_avg": avg("tick_collect_ms", "tick_collects"),
+            # Tick-phase attribution (serving/flight_recorder.py
+            # PhaseTimer): mean ms/tick per phase — admit/sync/
+            # dispatch/wait/host partition each collected tick's
+            # duration, so these sum to the mean attributed tick time.
+            # THE number the next TPU window routes on: it answers
+            # "host dispatch vs device compute vs transfer" from the
+            # artifact alone (docs/observability.md). All zero when
+            # GGRMCP_BENCH_OBS=off (the recorder-overhead A/B).
+            "tick_phase_ms_avg": {
+                p: avg(f"tick_phase_{p}_ms", "tick_collects")
+                for p in PHASE_NAMES
+            },
             "admit_rounds": sb.get("admit_rounds", 0),
             "admit_ms_avg": avg("admit_ms", "admit_rounds"),
             "admit_ms_max": sb.get("admit_ms_max", 0.0),

@@ -393,8 +393,10 @@ class AsyncHygieneRule(Rule):
 class ProtoDriftRule(Rule):
     """Every scalar numeric ServingStats field must be NAMED in
     gateway/metrics.py's help descriptors (_SERVING_HELP; histogram
-    bases in _SERVING_HIST_HELP), and no descriptor may name a field
-    the proto no longer has. The runtime drift test
+    bases in _SERVING_HIST_HELP), every scalar numeric TickRecord
+    field — the per-tick surface the flight recorder and the unified
+    timeline render — in _TICK_HELP, and no descriptor may name a
+    field the proto no longer has. The runtime drift test
     (tests/test_observability.py) proves every field EXPORTS; this
     static complement proves every field is documented — the half a
     runtime test cannot see, because the generic-help fallback exports
@@ -402,14 +404,15 @@ class ProtoDriftRule(Rule):
 
     id = "proto-drift"
     title = (
-        "ServingStats scalar field missing from (or stale in) "
-        "gateway/metrics.py help descriptors"
+        "ServingStats/TickRecord scalar field missing from (or stale "
+        "in) gateway/metrics.py help descriptors"
     )
     precedent = (
         "PR 3 (CHANGES.md): ServingStats gauges were a hand-synced "
         "literal list — the 'added a field, forgot the gauge' class. "
         "Descriptor-driven export killed the gauge half; this rule "
-        "kills the surviving help-text half."
+        "kills the surviving help-text half (TickRecord coverage added "
+        "with the tick-phase/timeline surface)."
     )
 
     PROTO = "protos/serving.proto"
@@ -418,25 +421,32 @@ class ProtoDriftRule(Rule):
         r"^\s*(repeated\s+)?([A-Za-z_][\w.]*)\s+(\w+)\s*=\s*\d+\s*;"
     )
 
-    def parse_proto(self, root: pathlib.Path):
-        """(scalar numeric field names, histogram base names) of
-        ServingStatsResponse, mirroring gateway/metrics.py's
-        descriptor-driven classification."""
+    def _message_fields(self, root: pathlib.Path, message: str):
+        """(repeated, type, name) triples of `message` in the serving
+        proto, or None when the message is absent (partial fixture
+        trees opt out per message)."""
         text = (root / self.PROTO).read_text()
         fields: list[tuple[bool, str, str]] = []
         in_msg = False
         for line in text.splitlines():
-            if re.match(r"\s*message\s+ServingStatsResponse\s*\{", line):
+            if re.match(rf"\s*message\s+{message}\s*\{{", line):
                 in_msg = True
                 continue
             if in_msg:
                 if line.strip() == "}":
-                    break
+                    return fields
                 m = self._FIELD_RE.match(line)
                 if m:
                     fields.append(
                         (bool(m.group(1)), m.group(2), m.group(3))
                     )
+        return fields if in_msg else None
+
+    def parse_proto(self, root: pathlib.Path):
+        """(scalar numeric field names, histogram base names) of
+        ServingStatsResponse, mirroring gateway/metrics.py's
+        descriptor-driven classification."""
+        fields = self._message_fields(root, "ServingStatsResponse") or []
         hist_bases = [
             name[: -len("_bucket")]
             for repeated, _, name in fields
@@ -452,6 +462,19 @@ class ProtoDriftRule(Rule):
         ]
         return scalars, hist_bases
 
+    def parse_tick(self, root: pathlib.Path):
+        """Scalar numeric TickRecord field names (the /debug/ticks and
+        timeline record surface _TICK_HELP must cover), or None when
+        the proto has no TickRecord message (fixture opt-out)."""
+        fields = self._message_fields(root, "TickRecord")
+        if fields is None:
+            return None
+        return [
+            name
+            for repeated, ftype, name in fields
+            if not repeated and ftype != "string"
+        ]
+
     def parse_help_dicts(self, root: pathlib.Path):
         """Keys + line numbers of _SERVING_HELP / _SERVING_HIST_HELP."""
         tree = ast.parse((root / self.METRICS).read_text())
@@ -462,7 +485,7 @@ class ProtoDriftRule(Rule):
                 and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)
                 and node.targets[0].id in (
-                    "_SERVING_HELP", "_SERVING_HIST_HELP"
+                    "_SERVING_HELP", "_SERVING_HIST_HELP", "_TICK_HELP"
                 )
                 and isinstance(node.value, ast.Dict)
             ):
@@ -483,10 +506,17 @@ class ProtoDriftRule(Rule):
             return  # partial fixture trees opt out of this contract
         scalars, hist_bases = self.parse_proto(root)
         dicts = self.parse_help_dicts(root)
-        for dict_name, names in (
+        tick_scalars = self.parse_tick(root)
+        contracts = [
             ("_SERVING_HELP", scalars),
             ("_SERVING_HIST_HELP", hist_bases),
-        ):
+        ]
+        if tick_scalars is not None:
+            # The TickRecord surface (tick ring → /debug/ticks →
+            # timeline) carries the same drift contract: every scalar
+            # documented, no descriptor naming a retired field.
+            contracts.append(("_TICK_HELP", tick_scalars))
+        for dict_name, names in contracts:
             if dict_name not in dicts:
                 yield self.finding(
                     self.METRICS, 1,

@@ -642,6 +642,16 @@ class LoggingConfig:
     level: str = "info"
     development: bool = False
     json_output: bool = True
+    # "json" switches gateway AND sidecar logging to structured
+    # one-line JSON records (utils/jsonlog.JsonFormatter): every line
+    # is parseable json.dumps output carrying ts/level/logger/msg plus
+    # the current trace id from the tracing contextvar, so process
+    # logs join /debug/traces, /debug/requests, and /debug/timeline by
+    # trace id. "" keeps the legacy format strings above (json_output
+    # interpolates into a JSON-shaped template without escaping —
+    # greppable, not parseable). GGRMCP_LOG_JSON=1 is the config-free
+    # opt-in for both processes.
+    format: str = ""  # "" | "json"
 
 
 @dataclass
@@ -816,6 +826,11 @@ class Config:
                 "batching.speculative does not compose with kv_ring: the "
                 "draft slot-pool cache is contiguous and the (gamma+1)-"
                 "position verify assumes the contiguous length mask"
+            )
+        if self.logging.format not in ("", "json"):
+            raise ValueError(
+                f"unknown logging.format {self.logging.format!r}; "
+                "supported: 'json' (or '' for the legacy formats)"
             )
         if self.training.steps < 1 or self.training.batch_size < 1:
             raise ValueError("training steps/batch_size must be >= 1")
@@ -1015,6 +1030,16 @@ def load_file(path: str, base: Optional[Config] = None) -> Config:
 
 _ENV_PREFIX = "GGRMCP_"
 
+# GGRMCP_-prefixed control vars that are NOT config-tree paths: the
+# chaos registry reads GGRMCP_FAILPOINTS at import
+# (utils/failpoints.py), setup_logging reads GGRMCP_LOG_JSON
+# (gateway/app.py), and GGRMCP_BENCH_* are bench knobs that leak into
+# co-launched serving processes' environments. Without the skip, a
+# process launched with any of them dies at config load with
+# "unknown config env var".
+_ENV_SKIP = frozenset({"GGRMCP_FAILPOINTS", "GGRMCP_LOG_JSON"})
+_ENV_SKIP_PREFIXES = ("GGRMCP_BENCH_",)
+
 
 def apply_env(cfg: Config, environ: Optional[dict[str, str]] = None) -> Config:
     """Apply GGRMCP_SECTION_KEY=value environment overrides.
@@ -1026,6 +1051,8 @@ def apply_env(cfg: Config, environ: Optional[dict[str, str]] = None) -> Config:
     environ = environ if environ is not None else dict(os.environ)
     for key, raw in environ.items():
         if not key.startswith(_ENV_PREFIX):
+            continue
+        if key in _ENV_SKIP or key.startswith(_ENV_SKIP_PREFIXES):
             continue
         parts = key[len(_ENV_PREFIX) :].lower().split("_")
         _apply_env_path(cfg, parts, raw, key)

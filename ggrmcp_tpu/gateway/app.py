@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import signal
 from typing import Optional
 
@@ -24,6 +25,20 @@ logger = logging.getLogger("ggrmcp.gateway")
 
 def setup_logging(cfg: Config) -> None:
     level = getattr(logging, cfg.logging.level.upper(), logging.INFO)
+    if cfg.logging.format == "json" or os.environ.get(
+        "GGRMCP_LOG_JSON"
+    ) == "1":
+        # Structured one-line JSON records carrying the current trace
+        # id from the tracing contextvar — both the gateway and the
+        # sidecar run through here, so their logs join /debug/traces,
+        # /debug/requests, and /debug/timeline by trace id
+        # (utils/jsonlog.py; docs/observability.md).
+        from ggrmcp_tpu.utils.jsonlog import JsonFormatter
+
+        handler = logging.StreamHandler()
+        handler.setFormatter(JsonFormatter())
+        logging.basicConfig(level=level, handlers=[handler], force=True)
+        return
     fmt = (
         '{"ts":"%(asctime)s","level":"%(levelname)s","logger":"%(name)s","msg":"%(message)s"}'
         if cfg.logging.json_output
@@ -72,6 +87,9 @@ class Gateway:
         app.router.add_get("/debug/ticks", self.handler.handle_debug_ticks)
         app.router.add_get(
             "/debug/requests", self.handler.handle_debug_requests
+        )
+        app.router.add_get(
+            "/debug/timeline", self.handler.handle_debug_timeline
         )
         return app
 

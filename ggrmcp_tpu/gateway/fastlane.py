@@ -14,7 +14,8 @@ precomputed-header `bytes` + body per call.
 
 Served surface is identical to the aiohttp app (gateway/app.py routes):
 GET/POST/OPTIONS /, /health, /metrics, /stats, /debug/traces,
-/debug/ticks, /debug/requests, SSE streaming on tools/call.
+/debug/ticks, /debug/requests, /debug/timeline, SSE streaming on
+tools/call.
 `server.http_impl` selects the implementation;
 both are driven by the same test suite (tests/test_fastlane.py runs the
 gateway protocol tests against this server).
@@ -610,7 +611,13 @@ class FastLaneServer:
                 path.rsplit("/", 1)[1],
                 query.get("trace_id", [""])[0],
                 query.get("n", ["128"])[0],
+                query.get("source", [""])[0],
             )
+            self._write_json(conn, headers, 200, body)
+            return 200
+        if path == "/debug/timeline":
+            query = parse_qs(urlsplit(target).query)
+            body = await h.timeline_body(query.get("n", ["512"])[0])
             self._write_json(conn, headers, 200, body)
             return 200
         self._write_response(conn, headers, 404, None, b"")

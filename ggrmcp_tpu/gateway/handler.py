@@ -31,7 +31,8 @@ from google.protobuf import json_format
 from ggrmcp_tpu.core.config import Config
 from ggrmcp_tpu.core.headers import HeaderFilter
 from ggrmcp_tpu.core.sessions import SessionContext, SessionManager
-from ggrmcp_tpu.gateway.metrics import GatewayMetrics
+from ggrmcp_tpu.gateway.metrics import GatewayMetrics, tick_field_help
+from ggrmcp_tpu.serving.timeline import build_timeline
 from ggrmcp_tpu.mcp import types as mcp
 from ggrmcp_tpu.mcp.validation import Validator, sanitize_error
 from ggrmcp_tpu.rpc.discovery import (
@@ -660,14 +661,18 @@ class MCPHandler:
         )
 
     async def debug_flight_body(
-        self, kind: str, trace_id: str, n_raw: str
+        self, kind: str, trace_id: str, n_raw: str, source: str = ""
     ) -> dict[str, Any]:
         """GET /debug/ticks | /debug/requests core: the backends'
         flight-recorder rings (DebugService.GetFlightRecord fan-out),
         filterable by the trace id a tool call echoed in X-Trace-Id —
-        the span → request record → tick records walk. `kind` is
-        "ticks" or "requests"; framework-free, shared by the aiohttp
-        handler and the fast lane."""
+        the span → request record → tick records walk — and by the
+        originating batcher's `source` label ("" flat pool,
+        "tier-<max_seq>", "spec"). `kind` is "ticks" or "requests";
+        framework-free, shared by the aiohttp handler and the fast
+        lane. The ticks body carries a `fields` help table
+        (metrics.tick_field_help — the proto-drift-enforced descriptor
+        set) so the record keys are self-describing."""
         try:
             n = int(n_raw)
         except ValueError:
@@ -685,15 +690,27 @@ class MCPHandler:
                     {"target": entry["target"], "error": entry["error"]}
                 )
             else:
+                # protojson omits empty repeated fields AND zero/empty
+                # scalars — a flat-pool record carries no "source" key
+                # at all, hence the .get default in the filter.
+                records = entry.get(kind, [])
+                if source:
+                    records = [
+                        r for r in records
+                        if r.get("source", "") == source
+                    ]
                 backends.append({
                     "target": entry["target"],
                     "enabled": entry.get("enabled", False),
-                    # protojson omits empty repeated fields.
-                    kind: entry.get(kind, []),
+                    kind: records,
                 })
         body: dict[str, Any] = {"backends": backends}
         if trace_id:
             body["traceId"] = trace_id
+        if source:
+            body["source"] = source
+        if kind == "ticks":
+            body["fields"] = tick_field_help()
         return body
 
     async def handle_debug_ticks(self, request: web.Request) -> web.Response:
@@ -701,6 +718,7 @@ class MCPHandler:
             "ticks",
             request.query.get("trace_id", ""),
             request.query.get("n", "128"),
+            request.query.get("source", ""),
         ))
 
     async def handle_debug_requests(
@@ -710,7 +728,34 @@ class MCPHandler:
             "requests",
             request.query.get("trace_id", ""),
             request.query.get("n", "128"),
+            request.query.get("source", ""),
         ))
+
+    async def timeline_body(self, n_raw: str) -> dict[str, Any]:
+        """GET /debug/timeline core: the unified Chrome trace-event
+        document (serving/timeline.py) — gateway spans plus every
+        backend's tick and request rings, phase attribution nested
+        inside each tick slice, lifecycle events as instants. Save the
+        JSON to a file and open it in Perfetto (ui.perfetto.dev) or
+        chrome://tracing. Framework-free, shared by both HTTP impls."""
+        try:
+            n = int(n_raw)
+        except ValueError:
+            n = 512
+        n = max(1, min(n, 2048))
+        entries = await self.discoverer.get_backend_flight_records(
+            max_ticks=n, max_requests=n
+        )
+        return build_timeline(
+            tracing.tracer.recent(min(n, 512)), entries
+        )
+
+    async def handle_debug_timeline(
+        self, request: web.Request
+    ) -> web.Response:
+        return web.json_response(
+            await self.timeline_body(request.query.get("n", "512"))
+        )
 
     # ------------------------------------------------------------------
     # helpers

@@ -106,6 +106,17 @@ _SERVING_HELP = {
     "mesh_devices": "devices in the serving mesh",
     "mesh_spec_downgrades":
         "sharding specs downgraded to replication (0 = true TP serving)",
+    "tick_phase_admit_ms":
+        "cumulative tick time in queue drain + admission prefill (ms)",
+    "tick_phase_sync_ms":
+        "cumulative tick time in host-state snapshots (tables/tokens/"
+        "grammar, ms)",
+    "tick_phase_dispatch_ms":
+        "cumulative tick time building + launching the jitted tick (ms)",
+    "tick_phase_wait_ms":
+        "cumulative tick time in device wait + transfer (ms)",
+    "tick_phase_host_ms":
+        "cumulative tick time in emission/finish bookkeeping (ms)",
 }
 
 _SERVING_HIST_HELP = {
@@ -113,7 +124,55 @@ _SERVING_HIST_HELP = {
     "e2e_ms": "backend submit-to-terminal-chunk latency (ms)",
     "queue_ms": "backend admission-queue wait (ms)",
     "tick_duration_ms": "decode tick dispatch-to-collect latency (ms)",
+    "tick_phase_admit_ms": "per-tick admit-phase time (ms)",
+    "tick_phase_sync_ms": "per-tick host-state-sync time (ms)",
+    "tick_phase_dispatch_ms": "per-tick jitted-dispatch time (ms)",
+    "tick_phase_wait_ms": "per-tick device-wait time (ms)",
+    "tick_phase_host_ms": "per-tick host-postprocess time (ms)",
 }
+
+# Per-phase histogram bases render as ONE family with a `phase` label
+# (gateway_backend_tick_phase_ms{target, phase}) so a dashboard can
+# overlay a tick's phases; everything else renders per-name.
+_PHASE_HIST_PREFIX = "tick_phase_"
+
+# /debug/ticks field help, keyed by TickRecord proto field name. Every
+# scalar numeric TickRecord field must be named here — graftlint's
+# proto-drift family enforces it (stale entries flagged), so the
+# timeline and the tick ring cannot silently drift from the proto. The
+# gateway serves this table (camelCased) as the `fields` key of the
+# /debug/ticks body.
+_TICK_HELP = {
+    "seq": "tick sequence number within its source batcher (1-based)",
+    "t_wall": "wall-clock epoch seconds at dispatch",
+    "t_mono": "monotonic stamp the duration/phases derive from",
+    "duration_ms":
+        "attributed tick time: admit + sync + dispatch + wait + host",
+    "active_slots": "slots decoding at dispatch",
+    "admitted": "slots activated since the previous tick",
+    "finished": "requests finished at this tick's collect",
+    "interleaved_rows": "prefill chunk rows fused into this tick",
+    "shed_total": "cumulative shed counter snapshotted at dispatch",
+    "replayed_total": "cumulative replay counter snapshotted at dispatch",
+    "timed_out_total":
+        "cumulative queue-timeout counter snapshotted at dispatch",
+    "spec_drafted": "draft tokens proposed on this tick (spec mode)",
+    "spec_accepted": "draft tokens accepted on this tick (spec mode)",
+    "kv_pages_in_use": "paged KV arena pages resident at dispatch",
+    "phase_admit_ms": "queue drain + admission prefill preceding the tick",
+    "phase_sync_ms":
+        "host-state snapshots (block tables, tokens, grammar tables)",
+    "phase_dispatch_ms": "building + launching the jitted tick",
+    "phase_wait_ms":
+        "device wait + transfer (incl. pipelined in-flight lag)",
+    "phase_host_ms": "emission, finish handling, allocator bookkeeping",
+}
+
+
+def tick_field_help() -> dict:
+    """The _TICK_HELP descriptor table keyed the way /debug/ticks
+    records are keyed (camelCase protojson)."""
+    return {_snake_to_camel(k): v for k, v in _TICK_HELP.items()}
 
 
 def _snake_to_camel(name: str) -> str:
@@ -185,8 +244,24 @@ class _ServingHistogramCollector:
         # target -> base name -> (bounds tuple, counts list, sum)
         self.snap: dict[str, dict[str, tuple]] = {}
 
+    @staticmethod
+    def _le_buckets(bounds, counts):
+        """Cumulative le-bucket pairs from non-cumulative counts (one
+        overflow slot past the bounds)."""
+        buckets = []
+        cum = 0
+        for bound, count in zip(bounds, counts):
+            cum += count
+            buckets.append((str(float(bound)), cum))
+        cum += sum(counts[len(bounds):])
+        buckets.append(("+Inf", cum))
+        return buckets
+
     def collect(self):
-        for name in serving_histogram_names():
+        names = serving_histogram_names()
+        for name in names:
+            if name.startswith(_PHASE_HIST_PREFIX):
+                continue  # grouped into the phase-labeled family below
             family = HistogramMetricFamily(
                 f"gateway_backend_{name}",
                 f"Backend ServingStats: "
@@ -198,15 +273,34 @@ class _ServingHistogramCollector:
                 if data is None:
                     continue
                 bounds, counts, total_sum = data
-                buckets = []
-                cum = 0
-                for bound, count in zip(bounds, counts):
-                    cum += count
-                    buckets.append((str(float(bound)), cum))
-                # counts carries one overflow slot past the bounds.
-                cum += sum(counts[len(bounds):])
-                buckets.append(("+Inf", cum))
-                family.add_metric([target], buckets, total_sum)
+                family.add_metric(
+                    [target], self._le_buckets(bounds, counts), total_sum
+                )
+            yield family
+        phased = [n for n in names if n.startswith(_PHASE_HIST_PREFIX)]
+        if phased:
+            # One family, phase-labeled: the tick-budget decomposition
+            # overlays on a single chart and PromQL can window
+            # quantiles per phase (sum by (phase, le)).
+            family = HistogramMetricFamily(
+                "gateway_backend_tick_phase_ms",
+                "Backend ServingStats: per-tick phase attribution (ms) "
+                "— admit/sync/dispatch/wait/host partition each tick's "
+                "duration",
+                labels=["target", "phase"],
+            )
+            for target in sorted(self.snap):
+                for name in phased:
+                    data = self.snap[target].get(name)
+                    if data is None:
+                        continue
+                    bounds, counts, total_sum = data
+                    phase = name[len(_PHASE_HIST_PREFIX):-len("_ms")]
+                    family.add_metric(
+                        [target, phase],
+                        self._le_buckets(bounds, counts),
+                        total_sum,
+                    )
             yield family
 
     def update(self, target: str, per_backend_entry: dict) -> bool:

@@ -30,7 +30,7 @@ Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
 # cardinality of the HTTP metrics path label.
 _KNOWN_PATHS = frozenset(
     {"/", "/health", "/metrics", "/stats", "/debug/traces",
-     "/debug/ticks", "/debug/requests"}
+     "/debug/ticks", "/debug/requests", "/debug/timeline"}
 )
 
 

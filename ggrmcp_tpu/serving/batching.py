@@ -41,7 +41,7 @@ from ggrmcp_tpu.ops.sampling import (
     sample_dynamic,
 )
 from ggrmcp_tpu.serving.engine import bucket_len, fit_request
-from ggrmcp_tpu.serving.flight_recorder import FlightRecorder
+from ggrmcp_tpu.serving.flight_recorder import PHASE_NAMES, FlightRecorder
 from ggrmcp_tpu.serving.pages import PageAllocator, PageExhaustedError
 from ggrmcp_tpu.utils import failpoints
 from ggrmcp_tpu.utils.stats import pct
@@ -503,6 +503,14 @@ class ContinuousBatcher:
         self.recorder = FlightRecorder(
             getattr(getattr(engine, "serving", None), "observability", None)
         )
+        # Tick-phase attribution (flight_recorder.PhaseTimer):
+        # cumulative per-phase ms over collected ticks (the ServingStats
+        # tick_phase_*_ms scalars; summable across tiers), and the
+        # executor admission time accumulated since the last dispatch —
+        # seeded into the NEXT tick's record as its admit phase, so a
+        # tick window shows the admission work that preceded it.
+        self.phase_ms = dict.fromkeys(PHASE_NAMES, 0.0)
+        self._admit_phase_ms = 0.0
 
         # jitted: one decode tick for the whole slot pool (params ride
         # as an argument — a closed-over weight tree would be lowered
@@ -2097,6 +2105,19 @@ class ContinuousBatcher:
             "tick_dispatch_ms": round(t["tick_dispatch_ms"], 2),
             "tick_collect_ms": round(t["tick_collect_ms"], 2),
             "admit_ms": round(t["admit_ms"], 2),
+            # Tick-phase attribution (flight recorder PhaseTimer;
+            # cumulative ms over collected ticks, divide by
+            # tick_collects for per-tick means): admit = queue drain +
+            # admission prefill preceding the tick, sync = host-state
+            # snapshots, dispatch = jitted launch, wait = device wait +
+            # transfer (in-flight), host = emission/finish bookkeeping.
+            # The five sum to the cumulative tick duration_ms — no
+            # unattributed time (docs/observability.md). Zeros when
+            # serving.observability is disabled, like the histograms.
+            **{
+                f"tick_phase_{p}_ms": round(self.phase_ms[p], 2)
+                for p in PHASE_NAMES
+            },
             # Worst single admission round — what the p50_budget_ms
             # cap bounds. NOT summable: the tiered facade takes the
             # max across tiers.
@@ -2669,6 +2690,10 @@ class ContinuousBatcher:
         self.timing["admit_ms"] += dt
         self.timing["admit_ms_max"] = max(self.timing["admit_ms_max"], dt)
         self.timing["admit_rounds"] += 1
+        # Phase attribution: this round's executor time seeds the NEXT
+        # tick record's admit phase (queue drain + admission prefill
+        # belong to the tick window they precede).
+        self._admit_phase_ms += dt
         # Interleave-queued rows ran no prefill here — feeding their
         # ~zero cost into the EMA would let the p50_budget_ms cap admit
         # unbounded short-prompt bursts on the strength of cheap
@@ -2909,10 +2934,15 @@ class ContinuousBatcher:
         while len(self._inflight) > depth:
             self._tick_collect_one()
 
-    def _tick_record(self, active, ilv_rows: int = 0):
+    def _tick_record(self, active):
         """Open this tick's flight record at dispatch (None when the
         recorder is disabled). seq is 1-based on timing["ticks"], the
-        same counter _activate_slot stamps first_tick from."""
+        same counter _activate_slot stamps first_tick from. The record
+        carries the tick's PhaseTimer — the dispatch paths mark "sync"
+        and "dispatch", the collect marks "wait", tick_done settles
+        "host" — and is seeded with the executor admission time
+        accumulated since the previous dispatch (the admit phase)."""
+        admit_ms, self._admit_phase_ms = self._admit_phase_ms, 0.0
         if not self.recorder.enabled:
             return None
         trace_ids = list(dict.fromkeys(
@@ -2922,26 +2952,32 @@ class ContinuousBatcher:
         return self.recorder.tick_start(
             seq=self.timing["ticks"] + 1,
             active=int(active.sum()),
-            interleaved_rows=ilv_rows,
+            interleaved_rows=0,  # chunk dispatchers stamp theirs post-create
             trace_ids=trace_ids,
             shed=self.shed,
             replayed=self.replayed,
             timed_out=self.timed_out,
             kv_pages_in_use=self.pages.in_use() if self._paged else 0,
+            admit_ms=admit_ms,
         )
 
     def _tick_dispatch(self) -> None:
-        self._sync_tables()
         t0 = time.perf_counter()
         step0 = self.step_counter
         self.step_counter += self._steps_per_tick
         active = np.array([s.active for s in self.slots], bool)
+        # Record FIRST so the PhaseTimer's contiguous marks cover the
+        # host-state sync below ("sync") and the jitted launch
+        # ("dispatch") — the phase sum must close on duration_ms.
         rec = self._tick_record(active)
+        self._sync_tables()
         if self._cur_dev is None:
             self._cur_dev = self._snap_dev(self.cur_tokens)
         if self._gstate_dev is None:
             self._gstate_dev = self._snap_dev(self.gstates)
         g_allow, g_trans = self._grammar_tables()
+        if rec is not None:
+            rec.phases.mark("sync")
         toks, self.cache, gstate_out = self._tick(
             self.engine.params, self._cur_dev, self.cache,
             jnp.asarray(self.seeds), jnp.int32(step0 + 1),
@@ -2967,6 +3003,8 @@ class ContinuousBatcher:
         self._inflight.append((toks, None, owners, rec))
         self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
         self.timing["ticks"] += 1
+        if rec is not None:
+            rec.phases.mark("dispatch")
 
     def _tick_spec_dispatch(self, chunk: bool = False) -> None:
         """The speculative twin of _tick_dispatch / _tick_dispatch_chunk:
@@ -2977,9 +3015,6 @@ class ContinuousBatcher:
         device-resident, so spec ticks pipeline exactly like plain
         ones; the host pulls (emit, count) at collect and advances each
         slot by its accepted count."""
-        if chunk:
-            self._ilv_fill_rows()
-        self._sync_tables()
         t0 = time.perf_counter()
         step0 = self.step_counter
         # gamma+1 target positions per round — decode_steps counts
@@ -2987,6 +3022,12 @@ class ContinuousBatcher:
         # stays unique across ticks.
         self.step_counter += self._gamma + 1
         active = np.array([s.active for s in self.slots], bool)
+        # Record first: the PhaseTimer must cover the host-state sync
+        # below (same contract as _tick_dispatch).
+        rec = self._tick_record(active)
+        if chunk:
+            self._ilv_fill_rows()
+        self._sync_tables()
         if self._cur_dev is None:
             self._cur_dev = self._snap_dev(self.cur_tokens)
         if self._prev_dev is None:
@@ -3006,9 +3047,12 @@ class ContinuousBatcher:
             (chunk_arr, offs, c_tl, c_valid, c_adapt) = (
                 self._ilv_chunk_inputs()
             )
-            rec = self._tick_record(active, ilv_rows=int(c_valid.sum()))
+            if rec is not None:
+                rec.interleaved_rows = int(c_valid.sum())
             if self._ilv_mini is None:
                 self._ilv_mini = self._make_mini(self._ilv_k, self.max_seq)
+            if rec is not None:
+                rec.phases.mark("sync")
             (
                 toks, counts, self.cache, self.dcache,
                 prev_out, cur_out, gstate_out, self._ilv_mini, sel,
@@ -3018,7 +3062,8 @@ class ContinuousBatcher:
                 jnp.asarray(c_valid), jnp.asarray(c_adapt),
             )
         else:
-            rec = self._tick_record(active)
+            if rec is not None:
+                rec.phases.mark("sync")
             (
                 toks, counts, self.cache, self.dcache,
                 prev_out, cur_out, gstate_out,
@@ -3038,6 +3083,10 @@ class ContinuousBatcher:
         self.spec_ticks += 1
         if chunk:
             self._ilv_advance(sel)
+        if rec is not None:
+            # After _ilv_advance: a final chunk's row finish (one small
+            # device call + activation) is dispatch-side host work.
+            rec.phases.mark("dispatch")
 
     def _ilv_fill_rows(self) -> None:
         """Claim queued chunk work items into free interleave rows."""
@@ -3088,21 +3137,27 @@ class ContinuousBatcher:
         final chunk this was finish right after (merge + first-token
         sample + activation — one small device call each, once per
         admission)."""
-        self._ilv_fill_rows()
-        self._sync_tables()
         t0 = time.perf_counter()
         step0 = self.step_counter
         self.step_counter += self._steps_per_tick
         active = np.array([s.active for s in self.slots], bool)
+        # Record first: the PhaseTimer must cover the host-state sync
+        # below (same contract as _tick_dispatch).
+        rec = self._tick_record(active)
+        self._ilv_fill_rows()
+        self._sync_tables()
         if self._cur_dev is None:
             self._cur_dev = self._snap_dev(self.cur_tokens)
         if self._ilv_mini is None:
             self._ilv_mini = self._make_mini(self._ilv_k, self.max_seq)
         chunk, offs, c_tl, c_valid, c_adapt = self._ilv_chunk_inputs()
-        rec = self._tick_record(active, ilv_rows=int(c_valid.sum()))
+        if rec is not None:
+            rec.interleaved_rows = int(c_valid.sum())
         if self._gstate_dev is None:
             self._gstate_dev = self._snap_dev(self.gstates)
         g_allow, g_trans = self._grammar_tables()
+        if rec is not None:
+            rec.phases.mark("sync")
         toks, self.cache, self._ilv_mini, sel, gstate_out = self._tick_chunk(
             self.engine.params, self._cur_dev, self.cache,
             jnp.asarray(self.seeds), jnp.int32(step0 + 1),
@@ -3124,6 +3179,10 @@ class ContinuousBatcher:
         self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
         self.timing["ticks"] += 1
         self._ilv_advance(sel)
+        if rec is not None:
+            # After _ilv_advance: a final chunk's row finish (one small
+            # device call + activation) is dispatch-side host work.
+            rec.phases.mark("dispatch")
 
     def _ilv_finish_row(self, r: int, sel) -> None:
         """Complete interleave row `r`: scatter its mini row into the
@@ -3161,6 +3220,11 @@ class ContinuousBatcher:
         # counts is the spec tick's per-row accepted+1 (None on plain
         # ticks): emission truncates to it, and accepted = count - 1.
         counts = None if counts_dev is None else np.asarray(counts_dev)
+        if rec is not None:
+            # Everything since the dispatch mark was in-flight wait:
+            # device compute + transfer, plus the deliberate one-tick
+            # lag (and the next tick's host work) under pipelining.
+            rec.phases.mark("wait")
         self.timing["tick_collect_ms"] += (time.perf_counter() - t0) * 1000.0
         self.timing["collects"] += 1
         finished = 0
@@ -3195,6 +3259,12 @@ class ContinuousBatcher:
         self.recorder.tick_done(
             rec, finished, spec_drafted=drafted, spec_accepted=accepted
         )
+        if rec is not None:
+            # Cumulative per-phase attribution (ServingStats
+            # tick_phase_*_ms): settled at tick_done, so the scalars
+            # and the per-phase histograms always agree.
+            for phase in PHASE_NAMES:
+                self.phase_ms[phase] += getattr(rec, f"phase_{phase}_ms")
 
     def _emit_chunk(self, slot_idx: int, tokens) -> None:
         """Deliver a tick's tokens for one slot: truncate at EOS or the

@@ -35,10 +35,46 @@ from typing import Optional
 
 from ggrmcp_tpu.core.config import ObservabilityConfig
 
-# The four latencies the recorder distributes, in the order their proto
-# fields appear (ServingStatsResponse 34-45). Keys double as the stats()
-# field prefixes: <name>_bucket / <name>_sum / <name>_count.
-HISTOGRAM_NAMES = ("ttft_ms", "e2e_ms", "queue_ms", "tick_duration_ms")
+# The tick phases the per-tick PhaseTimer attributes, in wall-clock
+# order within a tick: admit (queue drain + admission prefill since the
+# previous dispatch), sync (host-state snapshots — block tables,
+# cur/prev tokens, grammar tables), dispatch (building + launching the
+# jitted tick), wait (the blocking token collect: device wait +
+# transfer, plus the deliberate in-flight lag under pipelined ticks),
+# host (emission, finish handling, allocator bookkeeping). The phases
+# PARTITION a tick's duration_ms: their sum equals it by construction
+# (contiguous perf_counter marks), which is what makes "this tick lost
+# 3.1 ms to host-side table sync" a trustworthy statement.
+PHASE_NAMES = ("admit", "sync", "dispatch", "wait", "host")
+
+# The latencies the recorder distributes: the four lifecycle histograms
+# (ServingStatsResponse 34-45) plus one histogram per tick phase
+# (fields 67-81). Keys double as the stats() field prefixes:
+# <name>_bucket / <name>_sum / <name>_count.
+HISTOGRAM_NAMES = ("ttft_ms", "e2e_ms", "queue_ms", "tick_duration_ms") + tuple(
+    f"tick_phase_{p}_ms" for p in PHASE_NAMES
+)
+
+
+class PhaseTimer:
+    """Contiguous segment timer: mark(phase) charges the time since the
+    previous mark to `phase`. Because segments are contiguous from t0,
+    the accumulated phases always sum to (last - t0) exactly — the
+    closure property the tick-phase acceptance test asserts. Repeated
+    marks of the same phase accumulate."""
+
+    __slots__ = ("t0", "last", "acc")
+
+    def __init__(self) -> None:
+        self.t0 = self.last = time.perf_counter()
+        self.acc: dict = {}
+
+    def mark(self, phase: str) -> None:
+        now = time.perf_counter()
+        self.acc[phase] = (
+            self.acc.get(phase, 0.0) + (now - self.last) * 1000.0
+        )
+        self.last = now
 
 
 @dataclasses.dataclass
@@ -68,6 +104,22 @@ class TickRecord:
     # off): resident pages — live + reuse-cached — so a tick window
     # shows page pressure next to its admissions/finishes.
     kv_pages_in_use: int = 0
+    # Tick-phase attribution (PHASE_NAMES): where this tick's
+    # duration_ms went — admit/sync/dispatch/wait/host partition it, so
+    # the five always sum to duration_ms (PhaseTimer closure). admit is
+    # seeded at dispatch (executor admission time since the previous
+    # dispatch); the rest are stamped by contiguous marks and completed
+    # at collect, like finished/duration_ms.
+    phase_admit_ms: float = 0.0
+    phase_sync_ms: float = 0.0
+    phase_dispatch_ms: float = 0.0
+    phase_wait_ms: float = 0.0
+    phase_host_ms: float = 0.0
+    # The live timer carrying this tick's contiguous marks (None when
+    # the recorder is disabled); not part of the proto mirror.
+    phases: Optional[PhaseTimer] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def to_dict(self) -> dict:
         return {
@@ -86,6 +138,11 @@ class TickRecord:
             "specDrafted": self.spec_drafted,
             "specAccepted": self.spec_accepted,
             "kvPagesInUse": self.kv_pages_in_use,
+            "phaseAdmitMs": round(self.phase_admit_ms, 3),
+            "phaseSyncMs": round(self.phase_sync_ms, 3),
+            "phaseDispatchMs": round(self.phase_dispatch_ms, 3),
+            "phaseWaitMs": round(self.phase_wait_ms, 3),
+            "phaseHostMs": round(self.phase_host_ms, 3),
         }
 
 
@@ -187,16 +244,23 @@ class FlightRecorder:
         replayed: int,
         timed_out: int,
         kv_pages_in_use: int = 0,
+        admit_ms: float = 0.0,
     ) -> Optional[TickRecord]:
         """Record a tick at dispatch; returns the record so the caller
         can carry it alongside the in-flight device call and complete
-        it at collect (tick_done)."""
+        it at collect (tick_done). `admit_ms` seeds the record's admit
+        phase (executor admission time since the previous dispatch);
+        the remaining phases come from the record's PhaseTimer, whose
+        t0 doubles as t_mono so the phase sum closes on duration_ms."""
         if not self.enabled:
             return None
+        timer = PhaseTimer()
         rec = TickRecord(
             seq=seq,
             t_wall=time.time(),
-            t_mono=time.perf_counter(),
+            t_mono=timer.t0,
+            phases=timer,
+            phase_admit_ms=admit_ms,
             active_slots=active,
             admitted=self._admitted_since_tick,
             interleaved_rows=interleaved_rows,
@@ -218,19 +282,39 @@ class FlightRecorder:
         spec_drafted: int = 0,
         spec_accepted: int = 0,
     ) -> None:
-        """Complete a tick at its token collect: stamp the dispatch→
-        collect latency (the tick's real device duration; includes the
-        deliberate one-tick lag under pipelining), how many requests
-        finished on it, and — on speculative ticks — the round's
-        draft/accept counts (the per-tick acceptance trace)."""
+        """Complete a tick at its token collect: stamp the tick's
+        duration (admit seed + the contiguous admit-to-host span;
+        includes the deliberate one-tick lag under pipelining), settle
+        the phase attribution (the final `host` mark covers emission
+        and finish bookkeeping — the caller marked sync/dispatch/wait),
+        how many requests finished on it, and — on speculative ticks —
+        the round's draft/accept counts (the per-tick acceptance
+        trace)."""
         if rec is None:
             return
-        rec.duration_ms = (time.perf_counter() - rec.t_mono) * 1000.0
+        if rec.phases is not None:
+            rec.phases.mark("host")
+            acc = rec.phases.acc
+            rec.phase_sync_ms = acc.get("sync", 0.0)
+            rec.phase_dispatch_ms = acc.get("dispatch", 0.0)
+            rec.phase_wait_ms = acc.get("wait", 0.0)
+            rec.phase_host_ms = acc.get("host", 0.0)
+            # t_mono == the timer's t0, so this equals the phase sum
+            # exactly (the closure contract the acceptance test pins).
+            rec.duration_ms = rec.phase_admit_ms + (
+                rec.phases.last - rec.t_mono
+            ) * 1000.0
+        else:
+            rec.duration_ms = (time.perf_counter() - rec.t_mono) * 1000.0
         rec.finished = finished
         rec.spec_drafted = spec_drafted
         rec.spec_accepted = spec_accepted
         with self._lock:
             self._hists["tick_duration_ms"].observe(rec.duration_ms)
+            for phase in PHASE_NAMES:
+                self._hists[f"tick_phase_{phase}_ms"].observe(
+                    getattr(rec, f"phase_{phase}_ms")
+                )
 
     def record_request(
         self,
@@ -302,9 +386,9 @@ class FlightRecorder:
         return None
 
     def histogram_stats(self) -> dict:
-        """The ServingStats histogram fields (proto 33-45), keyed by
-        exact proto field name so ServingStatsResponse(**stats) drift
-        fails loudly."""
+        """The ServingStats histogram fields (proto 33-45 and the
+        per-phase triplets 67-81), keyed by exact proto field name so
+        ServingStatsResponse(**stats) drift fails loudly."""
         out = {"latency_bucket_bounds_ms": list(self._bounds)}
         with self._lock:
             for name, hist in self._hists.items():

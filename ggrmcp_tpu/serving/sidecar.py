@@ -673,6 +673,11 @@ class Sidecar:
                     spec_drafted=t.spec_drafted,
                     spec_accepted=t.spec_accepted,
                     kv_pages_in_use=t.kv_pages_in_use,
+                    phase_admit_ms=t.phase_admit_ms,
+                    phase_sync_ms=t.phase_sync_ms,
+                    phase_dispatch_ms=t.phase_dispatch_ms,
+                    phase_wait_ms=t.phase_wait_ms,
+                    phase_host_ms=t.phase_host_ms,
                 )
                 for t in ticks
             ],

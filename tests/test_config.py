@@ -104,6 +104,26 @@ def test_env_unknown_rejected():
         cfgmod.apply_env(cfgmod.default(), {"GGRMCP_NOPE_NOPE": "1"})
 
 
+def test_env_control_vars_skipped():
+    """GGRMCP_-prefixed vars consumed OUTSIDE the config tree — the
+    chaos registry (GGRMCP_FAILPOINTS), the JSON-log opt-in
+    (GGRMCP_LOG_JSON), and bench knobs that leak into co-launched
+    serving processes — must not kill a process at config load."""
+    cfg = cfgmod.default()
+    cfgmod.apply_env(
+        cfg,
+        {
+            "GGRMCP_FAILPOINTS": "tick_fail:every=7",
+            "GGRMCP_LOG_JSON": "1",
+            "GGRMCP_BENCH_OBS": "off",
+            "GGRMCP_BENCH_SESSIONS": "8",
+            "GGRMCP_SERVER_PORT": "9998",  # real paths still apply
+        },
+    )
+    assert cfg.server.port == 9998
+    assert cfg.serving.failpoints == ""  # registry arms it, not config
+
+
 def test_env_list_coercion():
     cfg = cfgmod.default()
     cfgmod.apply_env(

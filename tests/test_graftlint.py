@@ -471,6 +471,92 @@ class TestProtoDrift:
         assert len(report.suppressed) == 1
 
 
+TICK_PROTO_FIXTURE = PROTO_FIXTURE + """
+message TickRecord {
+  int64 seq = 1;
+  double duration_ms = 2;
+  double phase_wait_ms = 3;
+  repeated string trace_ids = 4;
+  string source = 5;
+}
+"""
+
+_COMPLETE_SERVING = """
+_SERVING_HELP = {
+    "active_slots": "decode slots generating",
+    "fresh_counter": "a documented counter",
+}
+_SERVING_HIST_HELP = {"ttft_ms": "time to first token"}
+"""
+
+
+class TestTickRecordDrift:
+    """The proto-drift family extended to the per-tick surface (the
+    tick ring → /debug/ticks → unified timeline): every scalar numeric
+    TickRecord field must be named in metrics.py's _TICK_HELP, stale
+    entries flagged — so the timeline cannot silently drift from the
+    proto."""
+
+    def write_tree(self, tmp_path, metrics_src: str, proto: str):
+        (tmp_path / "protos").mkdir(parents=True, exist_ok=True)
+        (tmp_path / "protos" / "serving.proto").write_text(proto)
+        path = tmp_path / "ggrmcp_tpu" / "gateway" / "metrics.py"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(metrics_src))
+        return run(tmp_path)
+
+    def test_fires_on_missing_and_stale_tick_entries(self, tmp_path):
+        report = self.write_tree(
+            tmp_path,
+            _COMPLETE_SERVING + """
+_TICK_HELP = {
+    "seq": "tick sequence number",
+    "duration_ms": "attributed tick time",
+    "retired_phase_ms": "gone from the proto",
+}
+""",
+            TICK_PROTO_FIXTURE,
+        )
+        assert rule_ids(report) == ["proto-drift", "proto-drift"]
+        messages = " | ".join(f.message for f in report.findings)
+        # The phase field added without a descriptor, and the stale
+        # descriptor naming a retired field — both directions.
+        assert "phase_wait_ms" in messages
+        assert "retired_phase_ms" in messages
+        # Repeated and string TickRecord fields carry no help contract.
+        assert "trace_ids" not in messages
+        assert "'source'" not in messages
+
+    def test_complete_tick_descriptors_clean(self, tmp_path):
+        report = self.write_tree(
+            tmp_path,
+            _COMPLETE_SERVING + """
+_TICK_HELP = {
+    "seq": "tick sequence number",
+    "duration_ms": "attributed tick time",
+    "phase_wait_ms": "device wait + transfer",
+}
+""",
+            TICK_PROTO_FIXTURE,
+        )
+        assert report.clean
+
+    def test_missing_tick_dict_is_a_finding(self, tmp_path):
+        report = self.write_tree(
+            tmp_path, _COMPLETE_SERVING, TICK_PROTO_FIXTURE
+        )
+        assert rule_ids(report) == ["proto-drift"]
+        assert "_TICK_HELP" in report.findings[0].message
+
+    def test_proto_without_tick_message_opts_out(self, tmp_path):
+        # Fixture trees whose proto has no TickRecord (the pre-phase
+        # shape) carry no _TICK_HELP contract.
+        report = self.write_tree(
+            tmp_path, _COMPLETE_SERVING, PROTO_FIXTURE
+        )
+        assert report.clean
+
+
 # ---------------------------------------------------------------------
 # 2. Pragma self-policing
 # ---------------------------------------------------------------------

@@ -106,7 +106,13 @@ class TestProtoDrift:
         gauges = set(serving_gauge_names())
         hists = set(serving_histogram_names())
         infos = set(serving_info_names())
-        assert hists == {"ttft_ms", "e2e_ms", "queue_ms", "tick_duration_ms"}
+        assert hists == {
+            "ttft_ms", "e2e_ms", "queue_ms", "tick_duration_ms",
+            # Tick-phase attribution: one histogram per phase, rendered
+            # as ONE gateway_backend_tick_phase_ms{phase} family.
+            *(f"tick_phase_{p}_ms"
+              for p in ("admit", "sync", "dispatch", "wait", "host")),
+        }
         # String fields export info-style (labels carry the value) —
         # mesh_shape is the first; a new string field lands there by
         # construction.

@@ -1,0 +1,489 @@
+"""Tick-phase attribution + unified Perfetto timeline (ISSUE 9, marker
+`obs`).
+
+The load-bearing guarantees:
+
+  * Phase accounting CLOSES — for every collected tick, admit + sync +
+    dispatch + wait + host equals the record's duration_ms within a
+    small epsilon, across fused/chunked/interleaved/paged/spec
+    dispatch paths (no unattributed time). This is what makes "this
+    tick lost 3.1 ms to host-side table sync" a trustworthy statement
+    before the TPU window spends minutes capturing it.
+  * /debug/timeline emits valid Chrome trace-event JSON (Perfetto-
+    loadable): ph/ts/dur/pid/tid well-formed, events time-ordered per
+    track, spans + ticks + request lifecycles present, and lifecycle
+    instants surface an injected failpoint from a chaos run.
+  * /debug/ticks and /debug/requests take source=/trace_id=/n= filters
+    identically on BOTH HTTP impls, and one inbound trace id agrees
+    across /debug/traces, /debug/requests, and a tick's trace_ids.
+  * logging.format=json emits parseable one-line JSON records carrying
+    the contextvar trace id, joining process logs to the timeline.
+"""
+
+import asyncio
+import io
+import json
+import logging
+
+import pytest
+
+from ggrmcp_tpu.core.config import (
+    BatchingConfig,
+    Config,
+    MeshConfig,
+    ServingConfig,
+)
+from ggrmcp_tpu.models import llama
+from ggrmcp_tpu.ops.sampling import SamplingConfig
+from ggrmcp_tpu.serving.batching import ContinuousBatcher
+from ggrmcp_tpu.serving.engine import GenerationEngine
+from ggrmcp_tpu.serving.flight_recorder import PHASE_NAMES, PhaseTimer
+from ggrmcp_tpu.serving.timeline import build_timeline
+from ggrmcp_tpu.utils import failpoints, tracing
+
+pytestmark = pytest.mark.obs
+
+GREEDY = SamplingConfig(temperature=0.0)
+
+
+def _mesh():
+    return MeshConfig(tensor=2, data=0)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return GenerationEngine(
+        llama.CONFIGS["tiny-llama"],
+        ServingConfig(mesh=_mesh()),
+    )
+
+
+@pytest.fixture(scope="module")
+def spec_engine():
+    return GenerationEngine(
+        llama.CONFIGS["tiny-llama"],
+        ServingConfig(mesh=_mesh(), speculative_draft="tiny-llama"),
+    )
+
+
+def _batcher(engine, **cfg_kw) -> ContinuousBatcher:
+    cfg_kw.setdefault("max_batch_size", 4)
+    cfg_kw.setdefault("kv_cache_max_seq", 256)
+    cfg_kw.setdefault("max_queue_delay_ms", 2.0)
+    return ContinuousBatcher(engine, BatchingConfig(**cfg_kw))
+
+
+async def _consume(batcher, prompt, max_new, seed=0):
+    out = []
+    async for ids, _reason in batcher.submit(
+        list(prompt), max_new, GREEDY, seed=seed
+    ):
+        out.extend(ids)
+    return out
+
+
+async def _drive(engine, prompts, max_new=6, **cfg_kw):
+    """Run `prompts` through a fresh batcher and return it (stopped;
+    recorder rings intact)."""
+    batcher = _batcher(engine, **cfg_kw)
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, batcher.warmup)
+    batcher.start()
+    try:
+        await asyncio.gather(*(
+            _consume(batcher, p, max_new, seed=i)
+            for i, p in enumerate(prompts)
+        ))
+    finally:
+        await batcher.stop()
+    return batcher
+
+
+def _phase_sum(rec) -> float:
+    return (
+        rec.phase_admit_ms + rec.phase_sync_ms + rec.phase_dispatch_ms
+        + rec.phase_wait_ms + rec.phase_host_ms
+    )
+
+
+def _assert_closure(batcher):
+    """Collected ticks (duration stamped at collect) must attribute
+    every millisecond: phase sum == duration_ms within epsilon."""
+    ticks = [
+        t for t in batcher.recorder.tick_snapshot() if t.duration_ms > 0
+    ]
+    assert ticks, "no collected tick records"
+    for t in ticks:
+        assert _phase_sum(t) == pytest.approx(t.duration_ms, abs=0.05), (
+            f"tick {t.seq}: phases {_phase_sum(t):.3f} != "
+            f"duration {t.duration_ms:.3f}"
+        )
+        # wait (device compute + transfer) is never literally zero.
+        assert t.phase_wait_ms > 0
+    # The cumulative ServingStats scalars agree with the records.
+    total = sum(batcher.phase_ms.values())
+    assert total == pytest.approx(
+        sum(t.duration_ms for t in ticks), abs=0.05 * len(ticks) + 0.1
+    )
+    stats = batcher.counter_stats()
+    for phase in PHASE_NAMES:
+        assert f"tick_phase_{phase}_ms" in stats
+    return ticks
+
+
+class TestPhaseTimer:
+    def test_contiguous_marks_partition_the_interval(self):
+        timer = PhaseTimer()
+        timer.mark("a")
+        timer.mark("b")
+        timer.mark("a")  # repeated marks accumulate
+        total = (timer.last - timer.t0) * 1000.0
+        assert sum(timer.acc.values()) == pytest.approx(total, abs=1e-9)
+        assert set(timer.acc) == {"a", "b"}
+
+
+class TestPhaseClosure:
+    async def test_fused_path(self, engine):
+        batcher = await _drive(engine, [[5, 6, 7], [9, 10, 11, 12]])
+        _assert_closure(batcher)
+
+    async def test_chunked_path(self, engine):
+        batcher = await _drive(
+            engine, [list(range(3, 83)), list(range(4, 74))],
+            prefill_chunk=32,
+        )
+        _assert_closure(batcher)
+
+    async def test_interleaved_path(self, engine):
+        batcher = _batcher(
+            engine, prefill_chunk=32, prefill_interleave="on",
+            prefill_interleave_rows=2,
+        )
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, batcher.warmup)
+        batcher.start()
+        try:
+            # A long prompt must land while a slot is decoding to take
+            # the fused tick+chunk dispatch (_tick_dispatch_chunk).
+            short = asyncio.ensure_future(
+                _consume(batcher, [5, 6, 7], 48)
+            )
+            await asyncio.sleep(0.15)
+            await _consume(batcher, list(range(3, 120)), 4, seed=1)
+            await short
+        finally:
+            await batcher.stop()
+        ticks = _assert_closure(batcher)
+        assert any(t.interleaved_rows > 0 for t in ticks), (
+            "interleaved dispatch path was not exercised"
+        )
+
+    async def test_paged_path(self, engine):
+        preamble = list(range(3, 67))
+        batcher = await _drive(
+            engine,
+            [preamble + [70 + i] for i in range(3)],
+            paged_kv="on", paged_kv_page_size=16,
+        )
+        _assert_closure(batcher)
+
+    async def test_spec_path(self, spec_engine):
+        batcher = await _drive(
+            spec_engine, [[5, 6, 7], [9, 10, 11]], speculative="on",
+        )
+        ticks = _assert_closure(batcher)
+        assert batcher.spec_ticks > 0
+        assert any(t.spec_drafted > 0 for t in ticks)
+
+    async def test_disabled_recorder_attributes_nothing(self, engine):
+        from ggrmcp_tpu.core.config import ObservabilityConfig
+
+        eng = GenerationEngine(
+            llama.CONFIGS["tiny-llama"],
+            ServingConfig(
+                mesh=_mesh(),
+                observability=ObservabilityConfig(enabled=False),
+            ),
+        )
+        batcher = await _drive(eng, [[5, 6, 7]])
+        assert batcher.recorder.tick_snapshot() == []
+        assert all(v == 0.0 for v in batcher.phase_ms.values())
+        stats = batcher.counter_stats()
+        assert stats["tick_phase_wait_ms"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# The unified timeline + debug filters (gateway + real sidecar e2e)
+# ---------------------------------------------------------------------------
+
+
+def _validate_chrome_trace(doc: dict) -> None:
+    """Schema-check the trace-event document: well-formed events,
+    time-ordered per (pid, tid) track, JSON-serializable."""
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    per_track: dict = {}
+    for ev in events:
+        assert ev["ph"] in {"X", "i", "M"}, ev
+        assert isinstance(ev["pid"], int)
+        assert isinstance(ev["tid"], int)
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        assert isinstance(ev.get("name"), str) and ev["name"]
+        if ev["ph"] == "X":
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        if ev["ph"] != "M":
+            per_track.setdefault((ev["pid"], ev["tid"]), []).append(
+                ev["ts"]
+            )
+    for stamps in per_track.values():
+        assert stamps == sorted(stamps), "events not time-ordered per track"
+    json.dumps(doc)
+
+
+class TestTimelineEndpoint:
+    async def test_timeline_spans_ticks_requests_and_chaos_instant(self):
+        from tests.test_observability import _generate_call, observed_env
+
+        tracing.tracer.clear()
+        # Chaos: one injected tick failure → replay → a lifecycle
+        # instant must surface on the timeline.
+        failpoints.registry.arm("tick_fail", every=4, times=1)
+        try:
+            async with observed_env("fastlane") as (_side, _gw, client):
+                await _generate_call(client, "trace-tl-a", max_new=8)
+                await _generate_call(client, "trace-tl-b", max_new=8)
+                resp = await client.get("/debug/timeline")
+                assert resp.status == 200
+                doc = await resp.json()
+        finally:
+            failpoints.registry.disarm()
+        _validate_chrome_trace(doc)
+        cats = {e.get("cat") for e in doc["traceEvents"]}
+        assert {"span", "tick", "tick.phase", "request"} <= cats
+        instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+        assert any(e["name"] == "replay" for e in instants), (
+            "injected tick failure left no lifecycle instant"
+        )
+        # Request rows carry the tick-join keys.
+        req = next(
+            e for e in doc["traceEvents"] if e.get("cat") == "request"
+        )
+        assert req["args"]["firstTick"] >= 1
+        assert req["args"]["lastTick"] >= req["args"]["firstTick"]
+        # Tick slices nest their phase partition: the phase slices of a
+        # tick sum to its duration.
+        ticks = [
+            e for e in doc["traceEvents"]
+            if e.get("cat") == "tick" and e["dur"] > 0
+        ]
+        assert ticks
+        phases = [
+            e for e in doc["traceEvents"] if e.get("cat") == "tick.phase"
+        ]
+        t0 = ticks[0]
+        nested = [
+            p for p in phases
+            if p["pid"] == t0["pid"] and p["tid"] == t0["tid"]
+            and t0["ts"] <= p["ts"] <= t0["ts"] + t0["dur"]
+        ]
+        assert nested
+        assert sum(p["dur"] for p in nested) <= t0["dur"] + len(nested)
+
+    @pytest.mark.parametrize("impl", ["fastlane", "aiohttp"])
+    async def test_timeline_served_on_both_impls(self, impl):
+        from tests.test_observability import _generate_call, observed_env
+
+        async with observed_env(impl) as (_side, _gw, client):
+            await _generate_call(client, f"trace-tl-{impl}")
+            doc = await (await client.get("/debug/timeline")).json()
+        _validate_chrome_trace(doc)
+        assert any(
+            e.get("cat") == "tick" for e in doc["traceEvents"]
+        )
+
+    def test_build_timeline_tolerates_errors_and_empties(self):
+        doc = build_timeline(
+            [], [{"target": "dead:1", "error": "unavailable"}]
+        )
+        assert doc["skippedBackends"] == ["dead:1"]
+        _validate_chrome_trace(doc)
+
+
+class TestDebugFilterParity:
+    TIERED = BatchingConfig(
+        max_batch_size=4, kv_cache_max_seq=256,
+        kv_tiers=[[128, 2], [256, 2]],
+    )
+
+    @pytest.mark.parametrize("impl", ["fastlane", "aiohttp"])
+    async def test_source_trace_and_n_filters(self, impl):
+        """source=/trace_id=/n= behave identically on both HTTP impls:
+        the tiered sidecar's records carry tier sources, a matching
+        filter returns only them, a non-ticking tier filters to empty,
+        and n= bounds the window."""
+        from tests.test_observability import _generate_call, observed_env
+
+        trace_id = f"trace-filters-{impl}"
+        async with observed_env(
+            impl, batching=self.TIERED
+        ) as (_side, _gw, client):
+            await _generate_call(client, trace_id)
+
+            body = await (await client.get(
+                "/debug/ticks", params={"source": "tier-128"}
+            )).json()
+            ticks = body["backends"][0]["ticks"]
+            assert ticks
+            assert all(t.get("source") == "tier-128" for t in ticks)
+            assert body["source"] == "tier-128"
+            # The ticks body is self-describing: the proto-drift-
+            # enforced field help table rides along.
+            assert body["fields"]["phaseWaitMs"]
+            assert body["fields"]["durationMs"]
+            # Phase attribution is visible per record.
+            assert float(ticks[-1]["phaseWaitMs"]) > 0
+
+            empty = await (await client.get(
+                "/debug/ticks", params={"source": "tier-256"}
+            )).json()
+            assert empty["backends"][0]["ticks"] == []
+
+            one = await (await client.get(
+                "/debug/ticks", params={"n": "1"}
+            )).json()
+            assert len(one["backends"][0]["ticks"]) == 1
+
+            reqs = await (await client.get(
+                "/debug/requests",
+                params={"source": "tier-128", "trace_id": trace_id},
+            )).json()
+            [rec] = reqs["backends"][0]["requests"]
+            assert rec["traceId"] == trace_id
+            none = await (await client.get(
+                "/debug/requests", params={"source": "tier-256"}
+            )).json()
+            assert none["backends"][0]["requests"] == []
+
+
+class TestTracePropagation:
+    async def test_one_trace_id_agrees_across_all_three_surfaces(self):
+        """One tools/call with an inbound x-trace-id surfaces the SAME
+        id in the span ring (/debug/traces), the request ring
+        (/debug/requests), and at least one tick record's trace_ids —
+        the three diagnostic surfaces cannot silently disagree."""
+        from tests.test_observability import _generate_call, observed_env
+
+        tracing.tracer.clear()
+        trace_id = "trace-propagation-e2e"
+        async with observed_env("fastlane") as (_side, _gw, client):
+            await _generate_call(client, trace_id)
+
+            spans = (await (
+                await client.get("/debug/traces")
+            ).json())["spans"]
+            named = [s for s in spans if s["traceId"] == trace_id]
+            assert named, "span ring lost the inbound trace id"
+            assert any(
+                s["name"] == "sidecar.generate" for s in named
+            ), "sidecar span did not continue the gateway trace"
+
+            reqs = await (await client.get(
+                "/debug/requests", params={"trace_id": trace_id}
+            )).json()
+            [rec] = reqs["backends"][0]["requests"]
+            assert rec["traceId"] == trace_id
+
+            ticks = (await (await client.get(
+                "/debug/ticks", params={"trace_id": trace_id}
+            )).json())["backends"][0]["ticks"]
+            assert ticks, "no tick record carries the trace id"
+            assert all(trace_id in t["traceIds"] for t in ticks)
+
+
+# ---------------------------------------------------------------------------
+# Structured JSON logging
+# ---------------------------------------------------------------------------
+
+
+class TestJsonLogging:
+    def _capture(self):
+        from ggrmcp_tpu.utils.jsonlog import JsonFormatter
+
+        stream = io.StringIO()
+        handler = logging.StreamHandler(stream)
+        handler.setFormatter(JsonFormatter())
+        logger = logging.getLogger("ggrmcp.test.jsonlog")
+        logger.setLevel(logging.INFO)
+        logger.addHandler(handler)
+        logger.propagate = False
+        return logger, handler, stream
+
+    def test_records_are_parseable_and_carry_trace_id(self):
+        logger, handler, stream = self._capture()
+        try:
+            with tracing.tracer.span("test.span", trace_id="tl-log-1"):
+                logger.warning("inside %s", "span")
+            logger.info("outside")
+        finally:
+            logger.removeHandler(handler)
+        lines = [
+            json.loads(line)
+            for line in stream.getvalue().splitlines() if line
+        ]
+        assert lines[0]["msg"] == "inside span"
+        assert lines[0]["level"] == "WARNING"
+        assert lines[0]["logger"] == "ggrmcp.test.jsonlog"
+        assert lines[0]["trace_id"] == "tl-log-1"
+        assert lines[0]["ts"] > 0
+        # Outside any span there is no trace id key at all.
+        assert "trace_id" not in lines[1]
+
+    def test_exceptions_serialize(self):
+        logger, handler, stream = self._capture()
+        try:
+            try:
+                raise ValueError("boom \"quoted\"")
+            except ValueError:
+                logger.exception("failed")
+        finally:
+            logger.removeHandler(handler)
+        rec = json.loads(stream.getvalue().strip())
+        assert rec["msg"] == "failed"
+        assert "ValueError" in rec["exc"]
+
+    def test_setup_logging_opt_in(self, monkeypatch):
+        """logging.format=json (and GGRMCP_LOG_JSON=1) swap the root
+        handlers to the JSON formatter; restored after so the test
+        process's logging is untouched."""
+        from ggrmcp_tpu.gateway.app import setup_logging
+        from ggrmcp_tpu.utils.jsonlog import JsonFormatter
+
+        root = logging.getLogger()
+        saved_handlers = root.handlers[:]
+        saved_level = root.level
+        try:
+            cfg = Config()
+            cfg.logging.format = "json"
+            cfg.validate()
+            setup_logging(cfg)
+            assert any(
+                isinstance(h.formatter, JsonFormatter)
+                for h in root.handlers
+            )
+            # Env-var opt-in, config-free.
+            root.handlers[:] = []
+            monkeypatch.setenv("GGRMCP_LOG_JSON", "1")
+            setup_logging(Config())
+            assert any(
+                isinstance(h.formatter, JsonFormatter)
+                for h in root.handlers
+            )
+        finally:
+            root.handlers[:] = saved_handlers
+            root.setLevel(saved_level)
+
+    def test_bad_format_rejected(self):
+        cfg = Config()
+        cfg.logging.format = "logfmt"
+        with pytest.raises(ValueError, match="logging.format"):
+            cfg.validate()
