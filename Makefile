@@ -119,6 +119,13 @@ graftlint:
 # the historical pre-fix code shape), pragma mechanics, the
 # security-scan smoke, and the tree-wide self-enforcement test.
 # Tier-1 runs these too; this is the fast inner loop for rule work.
+# Replica-routing net alone: placement policies, rendezvous affinity
+# stability, spill/drain semantics, replica-kill + drain-under-load
+# chaos, and the /admin/drain surface on both http impls. Tier-1 runs
+# these too; this target is the fast inner loop for rpc/router.py work.
+test-routing:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m routing
+
 test-analysis:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m analysis
 

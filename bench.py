@@ -78,6 +78,24 @@ Environment knobs:
                          preamble agentic workload and exports tokens/s,
                          prefix hit rates, and KV HBM in use for both
                          modes (paged_* extras; docs/paged_kv.md).
+  GGRMCP_BENCH_REPLICAS=N  N-replica routing phase (standalone mode,
+                         like PROXY_ONLY): spins N paged-KV sidecar
+                         replica PROCESSES behind one gateway and
+                         measures the routing plane — aggregate
+                         calls/s at 1 vs N replicas (scaling curve)
+                         and a round_robin vs affinity policy A/B on a
+                         sessionful shared-preamble workload, with
+                         per-replica paged-prefix hit rates and the
+                         affinity hit/spill counters in the artifact
+                         (docs/routing.md). Host-process replicas on
+                         the CPU platform: the phase measures
+                         placement + cache locality, not chip count.
+                         Knobs: GGRMCP_BENCH_REPLICA_SESSIONS (16),
+                         GGRMCP_BENCH_REPLICA_CALLS (16 per session),
+                         GGRMCP_BENCH_REPLICA_SLOTS (4),
+                         GGRMCP_BENCH_REPLICA_PAGES (192 — sized so
+                         sprayed placement thrashes the per-replica
+                         page index while an affinity share fits).
   GGRMCP_BENCH_CPU=1     force the CPU platform (tiny model)
 """
 
@@ -1899,6 +1917,321 @@ async def _proxy_bench() -> dict:
     }
 
 
+async def _replica_worker() -> None:
+    """One paged-KV sidecar replica subprocess for the N-replica
+    routing phase (GGRMCP_BENCH_REPLICA_WORKER=1): starts on an
+    ephemeral port, prints TARGET=<target>, serves until the parent
+    kills it. The parent pins JAX_PLATFORMS=cpu in the env — replicas
+    are host processes; a real TPU fleet runs one per chip slice."""
+    import logging
+
+    logging.basicConfig(level=logging.WARNING, stream=sys.stderr)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", _compile_cache_dir())
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from ggrmcp_tpu.core.config import BatchingConfig, ServingConfig
+    from ggrmcp_tpu.serving.sidecar import Sidecar
+
+    serving = ServingConfig(
+        model=os.environ.get("GGRMCP_BENCH_REPLICA_MODEL", "tiny-llama"),
+        batching=BatchingConfig(
+            max_batch_size=int(
+                os.environ.get("GGRMCP_BENCH_REPLICA_SLOTS", "4")
+            ),
+            kv_cache_max_seq=512,
+            decode_steps_per_tick=1,
+            # The phase exists to show placement protecting the paged
+            # page index: the 192-page arena cannot hold the
+            # 16-session preamble working set (16 x 15 pages, and live
+            # preamble pages alias the index), so spraying sessions
+            # across replicas (round_robin — or ONE replica) LRU-
+            # thrashes every replica's index, while an affinity share
+            # (8 x 15 + ~2 exclusive pages per live row) fits with
+            # headroom (docs/paged_kv.md thrash regime, per replica).
+            paged_kv="on",
+            paged_kv_page_size=16,
+            paged_kv_pages=int(
+                os.environ.get("GGRMCP_BENCH_REPLICA_PAGES", "192")
+            ),
+        ),
+    )
+    sidecar = Sidecar(serving)
+    await sidecar.start(0)
+    print(f"TARGET={sidecar.target}", flush=True)
+    await asyncio.Event().wait()  # parent kills the process
+
+
+async def _replica_bench(n_replicas: int) -> dict:
+    """N sidecar replicas behind ONE gateway: the routing-plane
+    measurement (ROADMAP item 4, docs/routing.md).
+
+    Three points, all over the same sessionful workload (every session
+    re-sends its own ~270-char preamble each call — the agentic
+    deployment shape):
+
+      1. affinity @ 1 replica  — the scaling-curve baseline. One
+         replica's page arena holds only ~half the preamble working
+         set, so the workload thrashes its prefix index.
+      2. round_robin @ N       — placement sprays each session across
+         every replica: every replica sees the FULL working set and
+         the thrash follows the traffic (the A/B control).
+      3. affinity @ N          — rendezvous hashing gives each replica
+         a disjoint session share that FITS its arena: per-replica
+         paged-prefix hit rate recovers, and with it aggregate
+         calls/s (the prefill a hit skips is the scaling headroom on
+         a shared host; on separate hosts compute scales too).
+
+    Cache state never leaks between points: each point's prompts carry
+    the point's tag, so a later point never hits pages a previous one
+    registered."""
+    import logging
+
+    logging.getLogger("ggrmcp.gateway.http").setLevel(logging.WARNING)
+    import aiohttp
+
+    from ggrmcp_tpu.core import config as cfgmod
+    from ggrmcp_tpu.gateway.app import Gateway
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sessions = int(os.environ.get("GGRMCP_BENCH_REPLICA_SESSIONS", "16"))
+    calls_per_session = int(
+        os.environ.get("GGRMCP_BENCH_REPLICA_CALLS", "16")
+    )
+    max_new = 8
+    tool = "ggrmcp_tpu_generateservice_generate"
+    # ~250-char preambles (byte tokenizer: chars == tokens == 15 full
+    # 16-token pages), session id at byte 1 so no cross-session prefix
+    # aliases. LRU re-reference distance decides the regimes: between a
+    # session's consecutive calls, ~15 other sessions' cold admissions
+    # (~17 fresh pages each, ~255 total) overrun the ~200-page
+    # evictable window when placement sprays (round_robin, or ONE
+    # replica) — full thrash — while affinity's ~7x17 (~119) fits.
+    PREAMBLE_PAGES = 15
+    filler = (
+        "You are the acme support desk assistant. Answer briefly, cite "
+        "the knowledge base, refuse speculation, escalate billing "
+        "disputes to a human, and never quote internal ticket ids. "
+    ) * 2
+
+    def prompt_template(tag: str) -> str:
+        # "{s}"/"{i}" are loadgen placeholders; the slice length counts
+        # "{s}" as 3 chars so the substituted preamble lands at 250-251
+        # chars (1- vs 2-digit session ids) — 15 full pages either way.
+        preamble = (f"s{{s}} {tag} acme support desk. " + filler)[:253]
+        return json.dumps({
+            "prompt": preamble + " t{i}.",
+            "maxNewTokens": max_new,
+        })
+
+    def stat(entry: dict, key: str) -> float:
+        try:
+            return float(entry.get(key, 0))
+        except (TypeError, ValueError):
+            return 0.0
+
+    env = {**os.environ, "GGRMCP_BENCH_REPLICA_WORKER": "1",
+           "JAX_PLATFORMS": "cpu"}
+    workers: list = []
+    targets: list[str] = []
+    try:
+        for _ in range(n_replicas):
+            workers.append(await asyncio.create_subprocess_exec(
+                sys.executable, os.path.abspath(__file__), env=env,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.DEVNULL,
+            ))
+        for w in workers:
+            line = await asyncio.wait_for(w.stdout.readline(), timeout=600)
+            text = line.decode().strip()
+            if not text.startswith("TARGET="):
+                raise RuntimeError(f"replica worker not ready: {text!r}")
+            targets.append(text.removeprefix("TARGET="))
+
+        async def measure(policy: str, pool: list, tag: str) -> dict:
+            cfg = cfgmod.default()
+            cfg.server.host = "127.0.0.1"
+            cfg.server.port = 0
+            cfg.server.rate_limit.enabled = False
+            cfg.session.rate_limit.enabled = False
+            cfg.grpc.reconnect.enabled = False
+            cfg.server.request_timeout_s = 600.0
+            cfg.grpc.call_timeout_s = 600.0
+            cfg.gateway.routing.policy = policy
+            # Strict affinity for the A/B: the phase measures PLACEMENT
+            # quality (cache locality), so load spills — unit-tested in
+            # tests/test_router.py — must not blur the contrast while a
+            # closed-loop burst saturates the small slot pools.
+            cfg.gateway.routing.spill_threshold = 0.0
+            gateway = Gateway(cfg, targets=pool)
+            await gateway.start()
+            base = f"http://127.0.0.1:{gateway.port}"
+            try:
+                async with aiohttp.ClientSession(base_url=base) as client:
+                    # Warm the compile ladder (R=1 prefill + grouped
+                    # admission buckets) on EVERY replica off the
+                    # measured clock: distinct throwaway preambles so
+                    # nothing below hits pages these register.
+                    async def warm_call(i: int) -> None:
+                        body = {
+                            "jsonrpc": "2.0", "method": "tools/call",
+                            "id": 50000 + i,
+                            "params": {"name": tool, "arguments": {
+                                "prompt": (f"warm {tag} {i}! " * 24)[:270],
+                                "maxNewTokens": max_new,
+                            }},
+                        }
+                        resp = await client.post("/", json=body)
+                        data = await resp.json()
+                        if "error" in data:
+                            raise RuntimeError(
+                                f"replica warm call failed: {data['error']}"
+                            )
+
+                    for i in range(2 * len(pool)):
+                        await warm_call(i)
+                    results = await asyncio.gather(
+                        *(warm_call(100 + i) for i in range(8)),
+                        return_exceptions=True,
+                    )
+                    errs = [
+                        r for r in results if isinstance(r, BaseException)
+                    ]
+                    if errs:
+                        raise errs[0]
+                disc = gateway.discoverer
+                stats0 = {
+                    e["target"]: e
+                    for e in await disc.get_backend_serving_stats()
+                    if "error" not in e
+                }
+                routing0 = disc.get_routing_stats()["backends"]
+                [gen] = await _drive_loadgens(
+                    [[
+                        sys.executable,
+                        os.path.join(repo, "scripts", "loadgen.py"),
+                        "--base-url", base,
+                        "--tool", tool,
+                        "--arguments-template", prompt_template(tag),
+                        "--sessions", str(sessions),
+                        "--calls-per-session", str(calls_per_session),
+                        "--warmup", "0",
+                    ]],
+                    ready_timeout=60, run_timeout=1800,
+                    capture_stderr=True, label=f"replica-{tag}",
+                )
+                stats1 = {
+                    e["target"]: e
+                    for e in await disc.get_backend_serving_stats()
+                    if "error" not in e
+                }
+                routing1 = disc.get_routing_stats()["backends"]
+            finally:
+                await gateway.stop()
+            elapsed = gen["end"] - gen["start"]
+            per_replica: dict[str, dict] = {}
+            aff_hits = aff_spills = total_picks = 0
+            for t in pool:
+                picks = (
+                    routing1.get(t, {}).get("routing_picks", 0)
+                    - routing0.get(t, {}).get("routing_picks", 0)
+                )
+
+                def delta(key: str) -> float:
+                    return stat(stats1.get(t, {}), key) - stat(
+                        stats0.get(t, {}), key
+                    )
+
+                reused = delta("pagedPagesReused")
+                per_replica[t] = {
+                    "picks": picks,
+                    # The headline: what fraction of the SHAREABLE
+                    # preamble pages each placement actually reused
+                    # (first call per (session, replica) is the
+                    # unavoidable cold miss). Page-granular — the
+                    # binary pagedPrefixHits counter scores a 1-token
+                    # CoW overlap the same as a full prefix reuse.
+                    "prefix_hit_rate": round(
+                        reused / (picks * PREAMBLE_PAGES), 4
+                    ) if picks else 0.0,
+                    # Raw counter ratio: reused / all pages admitted
+                    # (includes the unshareable tail + generation pages).
+                    "page_reuse_rate": round(
+                        reused / delta("pagedPagesAdmitted"), 4
+                    ) if delta("pagedPagesAdmitted") else 0.0,
+                }
+                total_picks += picks
+                aff_hits += (
+                    routing1.get(t, {}).get("affinity_hits", 0)
+                    - routing0.get(t, {}).get("affinity_hits", 0)
+                )
+                aff_spills += (
+                    routing1.get(t, {}).get("affinity_spills", 0)
+                    - routing0.get(t, {}).get("affinity_spills", 0)
+                )
+            latencies = sorted(gen["latencies_ms"])
+            return {
+                "policy": policy,
+                "calls_per_sec": round(gen["count"] / elapsed, 2),
+                "p50_ms": round(statistics.median(latencies), 1),
+                "p99_ms": round(nearest_rank(latencies, 0.99), 1),
+                "per_replica": per_replica,
+                "affinity_hit_rate": round(
+                    aff_hits / total_picks, 4
+                ) if total_picks else 0.0,
+                "affinity_spills": aff_spills,
+            }
+
+        one = await measure("affinity", [targets[0]], "one")
+        rr = await measure("round_robin", targets, "rr")
+        aff = await measure("affinity", targets, "aff")
+    finally:
+        for w in workers:
+            if w.returncode is None:
+                w.kill()
+        for w in workers:
+            await w.wait()
+
+    def hit_rates(point: dict) -> dict:
+        return {
+            t: r["prefix_hit_rate"] for t, r in point["per_replica"].items()
+        }
+
+    aff_rates = list(hit_rates(aff).values())
+    rr_rates = list(hit_rates(rr).values())
+    return {
+        "replica_count": n_replicas,
+        "replica_model": os.environ.get(
+            "GGRMCP_BENCH_REPLICA_MODEL", "tiny-llama"
+        ),
+        "replica_sessions": sessions,
+        "replica_calls_per_session": calls_per_session,
+        # Scaling curve (affinity policy at both points — the shipping
+        # configuration for sessionful fleets).
+        "replica_scaling": {
+            "1": one["calls_per_sec"],
+            str(n_replicas): aff["calls_per_sec"],
+        },
+        "replica_speedup": round(
+            aff["calls_per_sec"] / one["calls_per_sec"], 2
+        ) if one["calls_per_sec"] else 0.0,
+        # Policy A/B at N replicas.
+        "replica_rr_calls_per_sec": rr["calls_per_sec"],
+        "replica_aff_calls_per_sec": aff["calls_per_sec"],
+        "replica_rr_p50_ms": rr["p50_ms"],
+        "replica_aff_p50_ms": aff["p50_ms"],
+        "replica_rr_paged_hit_rate": hit_rates(rr),
+        "replica_aff_paged_hit_rate": hit_rates(aff),
+        "replica_one_paged_hit_rate": hit_rates(one),
+        "replica_aff_min_paged_hit_rate": round(min(aff_rates), 4),
+        "replica_rr_mean_paged_hit_rate": round(
+            sum(rr_rates) / len(rr_rates), 4
+        ),
+        "replica_affinity_hit_rate": aff["affinity_hit_rate"],
+        "replica_affinity_spills": aff["affinity_spills"],
+    }
+
+
 _ARTIFACT_DIR = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "bench_artifacts"
 )
@@ -2038,6 +2371,25 @@ def _cpu_fallback(reason: str) -> None:
 
 def main() -> None:
     from ggrmcp_tpu.core.config import QUANTIZE_MODES
+
+    if os.environ.get("GGRMCP_BENCH_REPLICA_WORKER") == "1":
+        # Sidecar replica for the N-replica routing phase. Checked
+        # FIRST: the worker inherits the parent's GGRMCP_BENCH_REPLICAS
+        # and must not recurse into the phase itself.
+        asyncio.run(_replica_worker())
+        return
+
+    replicas = int(os.environ.get("GGRMCP_BENCH_REPLICAS", "0") or "0")
+    if replicas:
+        # Standalone routing phase (like PROXY_ONLY): no TPU probe, no
+        # watchdog — replicas are CPU host processes by design.
+        result = asyncio.run(_replica_bench(max(2, replicas)))
+        _emit(json.dumps({
+            "metric": "replica_aggregate_calls_per_sec",
+            "value": result["replica_aff_calls_per_sec"],
+            "unit": "calls/s", **result,
+        }))
+        return
 
     if os.environ.get("GGRMCP_BENCH_PROXY_WORKER") == "1":
         # SO_REUSEPORT gateway worker for the multi-proc proxy phase

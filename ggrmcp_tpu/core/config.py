@@ -121,6 +121,7 @@ class HeaderForwardingConfig:
             "x-trace-id",
             "x-request-id",
             "x-user-id",
+            "x-session-id",
             "x-api-key",
             "user-agent",
             "accept-language",
@@ -506,10 +507,65 @@ class GrammarConfig:
     cache_entries: int = 32
 
 
+# Replica-routing policies (gateway.routing.policy) — the single source
+# of truth for config.validate() and rpc/router.py.
+ROUTING_POLICIES = ("round_robin", "least_loaded", "affinity")
+
+
+@dataclass
+class RoutingConfig:
+    """Load-aware replica routing over DP replica pools
+    (rpc/router.py, docs/routing.md). Applies whenever several
+    discovered backends serve the SAME method full name — the gateway
+    then chooses the serving replica per call instead of pinning to
+    one upstream (the reference's single-target limitation)."""
+
+    # "round_robin" — per-tool cursors over the healthy replica set
+    #   (the historical default; bitwise behavior-compatible with the
+    #   pre-router path).
+    # "least_loaded" — score each replica from the background
+    #   ServingStats snapshot (pending queue depth + EWMA TTFT) and
+    #   place on the cheapest one; routing never blocks on a gRPC
+    #   fan-out, and a stale/wedged snapshot degrades LOUDLY to
+    #   round-robin, never to a stall.
+    # "affinity" — rendezvous(HRW)-hash a stable per-call key
+    #   (x-session-id header, else tool name + the serialized-request
+    #   preamble) over the healthy replica set, so one replica
+    #   accumulates a session's paged-KV prefix pages instead of every
+    #   replica cold-prefilling them (docs/paged_kv.md). Affinity is a
+    #   PREFERENCE: an overloaded home replica spills to the least
+    #   loaded one (spill_threshold).
+    policy: str = "round_robin"
+    # Affinity key fallback: first N bytes of the canonically
+    # serialized arguments (sorted-key JSON), hashed with the tool
+    # name. Big enough to span a system-prompt preamble, small enough
+    # that the key derivation stays off the hot path's flamegraph.
+    affinity_preamble_bytes: int = 256
+    # Spill when the affinity-chosen replica's load score exceeds this
+    # (score units: 1.0 per queued request + EWMA TTFT / 100 ms).
+    # 0 disables spilling (strict affinity).
+    spill_threshold: float = 8.0
+    # EXPERIMENTAL (off by default): steer requests whose estimated
+    # prefill work exceeds steer_prefill_min_tokens toward replicas
+    # whose cumulative tick-phase attribution shows the smallest
+    # admit-phase (prefill) share — a cheap, signal-driven
+    # approximation of prefill/decode disaggregation using PR 9's
+    # phase scalars (docs/routing.md caveats). Only consulted when no
+    # affinity key applies; cache locality outranks steering.
+    steer_prefill: str = "off"  # off | on
+    steer_prefill_min_tokens: int = 1024
+    # ServingStats snapshots older than this are considered wedged:
+    # score-based policies fall back to round-robin (with a warning)
+    # until the background refresh recovers.
+    stale_stats_max_age_s: float = 30.0
+
+
 @dataclass
 class GatewayConfig:
     """Gateway-side behavior knobs (no reference analogue)."""
 
+    # Replica routing policy + affinity/drain knobs (rpc/router.py).
+    routing: RoutingConfig = field(default_factory=RoutingConfig)
     # Per-tool structured-output opt-in: MCP tool name → source of the
     # schema to enforce on that tool's generated text. "self" (or "")
     # enforces the tool's OWN output schema; any other value names a
@@ -813,6 +869,34 @@ class Config:
             raise ValueError(
                 "gateway.structured_output must map tool names to "
                 "'self' (or '') or another tool name"
+            )
+        routing = self.gateway.routing
+        if routing.policy not in ROUTING_POLICIES:
+            raise ValueError(
+                f"unknown gateway.routing.policy {routing.policy!r}; "
+                f"supported: {', '.join(ROUTING_POLICIES)}"
+            )
+        if routing.affinity_preamble_bytes < 1:
+            raise ValueError(
+                "gateway.routing.affinity_preamble_bytes must be >= 1"
+            )
+        if routing.spill_threshold < 0:
+            raise ValueError(
+                "gateway.routing.spill_threshold must be >= 0 "
+                "(0 = strict affinity, never spill)"
+            )
+        if routing.steer_prefill not in ("off", "on"):
+            raise ValueError(
+                "gateway.routing.steer_prefill must be 'off' or 'on' "
+                "(experimental — docs/routing.md)"
+            )
+        if routing.steer_prefill_min_tokens < 1:
+            raise ValueError(
+                "gateway.routing.steer_prefill_min_tokens must be >= 1"
+            )
+        if routing.stale_stats_max_age_s <= 0:
+            raise ValueError(
+                "gateway.routing.stale_stats_max_age_s must be > 0"
             )
         if self.serving.speculative_gamma < 1:
             raise ValueError("speculative_gamma must be >= 1")

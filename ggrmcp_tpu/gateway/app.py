@@ -60,7 +60,8 @@ class Gateway:
         self.metrics = GatewayMetrics()
         self.sessions = SessionManager(cfg.session)
         self.discoverer = discoverer or ServiceDiscoverer(
-            targets if targets is not None else [cfg.grpc.target], cfg.grpc
+            targets if targets is not None else [cfg.grpc.target], cfg.grpc,
+            routing=cfg.gateway.routing,
         )
         self.handler = MCPHandler(cfg, self.discoverer, self.sessions, self.metrics)
         # The aiohttp app (routes + middleware) is only built when that
@@ -90,6 +91,10 @@ class Gateway:
         )
         app.router.add_get(
             "/debug/timeline", self.handler.handle_debug_timeline
+        )
+        app.router.add_post("/admin/drain", self.handler.handle_admin_drain)
+        app.router.add_post(
+            "/admin/undrain", self.handler.handle_admin_undrain
         )
         return app
 

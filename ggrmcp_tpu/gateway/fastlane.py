@@ -489,10 +489,14 @@ class FastLaneServer:
                     ),
                     retry_after_s=1.0,
                 )
-            elif method == "POST" and not any(
+            elif method == "POST" and body and not any(
                 headers.get("content-type", "").startswith(a)
                 for a in self.allowed_ctypes
             ):
+                # `body and`: the gate polices request BODIES (aiohttp
+                # parity — its chain checks request.can_read_body), so
+                # a body-less POST like /admin/drain?backend=... needs
+                # no Content-Type.
                 status = 415
                 self._write_json(
                     conn, headers, 415,
@@ -584,6 +588,17 @@ class FastLaneServer:
                 return 200
             self._write_response(conn, headers, 405, None, b"")
             return 405
+        if path in ("/admin/drain", "/admin/undrain"):
+            if method != "POST":
+                self._write_response(conn, headers, 405, None, b"")
+                return 405
+            query = parse_qs(urlsplit(target).query)
+            body_dict, status = h.admin_drain_body(
+                query.get("backend", [""])[0],
+                drain=(path == "/admin/drain"),
+            )
+            self._write_json(conn, headers, status, body_dict)
+            return status
         if method != "GET":
             self._write_response(conn, headers, 405, None, b"")
             return 405

@@ -626,6 +626,7 @@ class MCPHandler:
         self.metrics.set_serving_stats(
             await self.discoverer.get_serving_stats_snapshot()
         )
+        self.metrics.set_routing_stats(self.discoverer.get_routing_stats())
         payload, content_type = self.metrics.render()
         return payload, content_type.split(";")[0]
 
@@ -638,6 +639,7 @@ class MCPHandler:
         parity (handler.go:367-376)."""
         stats = self.discoverer.get_service_stats()
         stats["sessions"] = self.sessions.stats()
+        stats["routing"] = self.discoverer.get_routing_stats()
         serving = await self.discoverer.get_backend_serving_stats()
         if serving:
             stats["serving"] = serving
@@ -645,6 +647,57 @@ class MCPHandler:
 
     async def handle_stats(self, request: web.Request) -> web.Response:
         return web.json_response(await self.stats_body())
+
+    # ------------------------------------------------------------------
+    # Admin: graceful drain (docs/routing.md runbook)
+    # ------------------------------------------------------------------
+
+    def admin_drain_body(
+        self, backend: str, drain: bool
+    ) -> tuple[dict[str, Any], int]:
+        """POST /admin/drain | /admin/undrain core (?backend=<target>):
+        flip a backend's drain state. Draining stops NEW placements
+        only — in-flight calls finish, health stays monitored,
+        rediscovery keeps the tools resolvable via the remaining
+        replicas; un-drain restores the candidate set. Framework-free,
+        shared by both HTTP impls."""
+        if not backend:
+            return {
+                "error": "missing ?backend=<target> query parameter",
+                "backends": [
+                    b["target"]
+                    for b in self.discoverer.get_service_stats()["backends"]
+                ],
+            }, 400
+        try:
+            state = self.discoverer.set_draining(backend, drain)
+        except KeyError:
+            return {
+                "error": f"unknown backend: {backend}",
+                "backends": [
+                    b["target"]
+                    for b in self.discoverer.get_service_stats()["backends"]
+                ],
+            }, 404
+        return {
+            "backend": backend,
+            "draining": drain,
+            "backends": state,
+        }, 200
+
+    async def handle_admin_drain(self, request: web.Request) -> web.Response:
+        body, status = self.admin_drain_body(
+            request.query.get("backend", ""), drain=True
+        )
+        return web.json_response(body, status=status)
+
+    async def handle_admin_undrain(
+        self, request: web.Request
+    ) -> web.Response:
+        body, status = self.admin_drain_body(
+            request.query.get("backend", ""), drain=False
+        )
+        return web.json_response(body, status=status)
 
     def traces_body(self, n_raw: str) -> dict[str, Any]:
         """GET /debug/traces core: recent per-call spans, newest first
@@ -711,6 +764,11 @@ class MCPHandler:
             body["source"] = source
         if kind == "ticks":
             body["fields"] = tick_field_help()
+        else:
+            # /debug/requests answers "why did THIS call go THERE":
+            # the router's policy + per-backend placement counters ride
+            # alongside the lifecycle records (docs/routing.md).
+            body["routing"] = self.discoverer.get_routing_stats()
         return body
 
     async def handle_debug_ticks(self, request: web.Request) -> web.Response:

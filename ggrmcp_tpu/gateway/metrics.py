@@ -102,6 +102,11 @@ _SERVING_HELP = {
     "paged_prefix_hits":
         "admissions that reused shared prefix pages or a CoW source",
     "paged_cow_copies": "divergent-page copy-on-writes",
+    "paged_pages_reused":
+        "prefix pages served from the shared index at admission",
+    "paged_pages_admitted":
+        "total pages admitted (reused/admitted = page-level reuse "
+        "fraction)",
     "tp_chips": "mesh tensor-axis size decode ticks shard over",
     "mesh_devices": "devices in the serving mesh",
     "mesh_spec_downgrades":
@@ -129,6 +134,23 @@ _SERVING_HIST_HELP = {
     "tick_phase_dispatch_ms": "per-tick jitted-dispatch time (ms)",
     "tick_phase_wait_ms": "per-tick device-wait time (ms)",
     "tick_phase_host_ms": "per-tick host-postprocess time (ms)",
+}
+
+# Replica-routing counter help (rpc/router.py COUNTER_NAMES): the
+# gateway-side complement of the backend ServingStats descriptors.
+# Every router counter exports as gateway_routing_<name>{target} —
+# built by iterating THIS table, so "added a counter, forgot the
+# metric" is impossible (the routing suite asserts the invariant).
+_ROUTING_HELP = {
+    "routing_picks":
+        "calls the router placed on this backend (any policy)",
+    "affinity_hits":
+        "affinity placements that landed on the rendezvous-chosen home",
+    "affinity_spills":
+        "affinity placements diverted off an overloaded home replica "
+        "(score > gateway.routing.spill_threshold)",
+    "drain_rejects":
+        "placements routed AWAY from this backend while it was draining",
 }
 
 # Per-phase histogram bases render as ONE family with a `phase` label
@@ -429,6 +451,29 @@ class GatewayMetrics:
         # can aggregate across backends and compute window quantiles.
         self.serving_histograms = _ServingHistogramCollector()
         self.registry.register(self.serving_histograms)
+        # Replica-routing placement counters (rpc/router.py), set from
+        # the discoverer's snapshot at scrape time like the serving
+        # gauges above. Gauges rather than Counters because the
+        # authoritative counts live on the router; the gateway only
+        # re-exposes the latest snapshot.
+        self.routing_gauges = {
+            name: Gauge(
+                # routing_picks → gateway_routing_picks; the rest gain
+                # the gateway_routing_ prefix (affinity_hits → ...).
+                f"gateway_routing_{name.removeprefix('routing_')}",
+                f"Replica routing: {help_text}",
+                ["target"],
+                registry=self.registry,
+            )
+            for name, help_text in _ROUTING_HELP.items()
+        }
+        self.routing_policy_info = Gauge(
+            "gateway_routing_policy_info",
+            "Active gateway.routing.policy (label carries the policy)",
+            ["policy"],
+            registry=self.registry,
+        )
+        self._routing_policy_seen = None
         # The overload early-warning gauge: admission-queue depth per
         # backend in both units (unit="requests" | "tokens") — watch
         # this against batching.max_pending / max_queue_tokens to see
@@ -549,6 +594,27 @@ class GatewayMetrics:
                     (id(self.batcher_pending_depth), target, unit), None
                 )
         self._serving_targets = live
+
+    def set_routing_stats(self, routing: dict) -> None:
+        """Record the router snapshot (ServiceDiscoverer.
+        get_routing_stats(): {"policy": ..., "backends": {target:
+        {counter: n}}}) as gateway_routing_* gauges."""
+        if self.registry is None:
+            return
+        policy = routing.get("policy", "")
+        if policy and policy != self._routing_policy_seen:
+            if self._routing_policy_seen is not None:
+                try:
+                    self.routing_policy_info.remove(
+                        self._routing_policy_seen
+                    )
+                except KeyError:
+                    pass
+            self.routing_policy_info.labels(policy).set(1)
+            self._routing_policy_seen = policy
+        for target, counters in routing.get("backends", {}).items():
+            for name, gauge in self.routing_gauges.items():
+                self._child(gauge, target).set(float(counters.get(name, 0)))
 
     def render(self) -> tuple[bytes, str]:
         """Prometheus text exposition."""

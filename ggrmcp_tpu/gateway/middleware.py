@@ -30,7 +30,8 @@ Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
 # cardinality of the HTTP metrics path label.
 _KNOWN_PATHS = frozenset(
     {"/", "/health", "/metrics", "/stats", "/debug/traces",
-     "/debug/ticks", "/debug/requests", "/debug/timeline"}
+     "/debug/ticks", "/debug/requests", "/debug/timeline",
+     "/admin/drain", "/admin/undrain"}
 )
 
 

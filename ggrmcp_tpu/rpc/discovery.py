@@ -16,16 +16,20 @@ streaming path is enabled instead of being rejected outright.
 from __future__ import annotations
 
 import asyncio
-import itertools
 import logging
 import time
 from typing import Any, AsyncIterator, Optional
 
-from ggrmcp_tpu.core.config import GRPCConfig
+from ggrmcp_tpu.core.config import GRPCConfig, RoutingConfig
 from ggrmcp_tpu.core.types import MethodInfo
 from ggrmcp_tpu.rpc.connection import ChannelManager
 from ggrmcp_tpu.rpc.descriptors import CommentIndex, DescriptorSetLoader
 from ggrmcp_tpu.rpc.reflection_client import DynamicInvoker, ReflectionClient
+from ggrmcp_tpu.rpc.router import (
+    ReplicaRouter,
+    derive_affinity_key,
+    estimate_prefill_tokens,
+)
 from ggrmcp_tpu.utils import failpoints
 
 logger = logging.getLogger("ggrmcp.rpc.discovery")
@@ -52,6 +56,11 @@ class Backend:
         self.methods: list[MethodInfo] = []
         self.comments = CommentIndex()
         self.healthy = False
+        # Graceful drain (POST /admin/drain): a draining backend takes
+        # no NEW placements — in-flight calls finish, rediscovery keeps
+        # its tools resolvable via the remaining replicas, un-drain
+        # restores it to the candidate set.
+        self.draining = False
         self.last_discovery: float = 0.0
 
     async def connect(self, timeout_s: Optional[float] = None) -> None:
@@ -103,6 +112,7 @@ class ServiceDiscoverer:
         targets: list[str] | str,
         cfg: Optional[GRPCConfig] = None,
         allow_streaming_tools: bool = True,
+        routing: Optional[RoutingConfig] = None,
     ):
         self.cfg = cfg or GRPCConfig()
         if isinstance(targets, str):
@@ -116,12 +126,12 @@ class ServiceDiscoverer:
         # swapped whole on rediscovery — lock-free reads under the GIL,
         # the Python analogue of atomic.Pointer (discovery.go:21,
         # 122-127). Multiple backends serving the SAME method full name
-        # are DP replicas: calls round-robin over the healthy ones.
+        # are DP replicas: the router places each call over the healthy,
+        # non-draining ones (rpc/router.py; round-robin by default).
         self._tools: dict[str, tuple[MethodInfo, list[Backend]]] = {}
-        # Per-tool round-robin cursors: a single shared counter would
-        # let interleaved multi-tool traffic pin each tool to one
-        # replica (tool A always landing on even counts, B on odd).
-        self._rr: dict[str, itertools.count] = {}
+        # Placement policy (gateway.routing): reads the serving-stats
+        # snapshot below, never a live fan-out.
+        self.router = ReplicaRouter(routing, stats_view=self._stats_view)
         self._watchdog_task: Optional[asyncio.Task] = None
         # ServingStats snapshot for /metrics: a Prometheus scrape must
         # not block on a live gRPC fan-out (a wedged sidecar would add
@@ -342,22 +352,72 @@ class ServiceDiscoverer:
 
     # -- invocation ---------------------------------------------------------
 
-    def _route(self, tool_name: str) -> tuple[MethodInfo, Backend]:
-        """Pick the serving replica: round-robin over healthy backends,
-        falling back to any connected one (per-shard routing from the
-        north star; DP replicas share a tool name)."""
+    def _route(
+        self,
+        tool_name: str,
+        arguments: Optional[dict[str, Any]] = None,
+        headers: Optional[list[tuple[str, str]]] = None,
+    ) -> tuple[MethodInfo, Backend]:
+        """Pick the serving replica (per-shard routing from the north
+        star; DP replicas share a tool name). Membership filtering
+        happens HERE, at pick time: unhealthy backends are skipped (a
+        dead replica must not keep eating every k-th call until
+        rediscovery), draining backends take no new placements, and the
+        router (gateway.routing.policy) places over what remains —
+        falling back to any connected non-draining backend only when
+        none is healthy."""
         entry = self._tools.get(tool_name)
         if entry is None:
             raise ToolNotFoundError(f"tool not found: {tool_name}")
         method, backends = entry
-        candidates = [
-            b for b in backends if b.invoker is not None and b.healthy
-        ] or [b for b in backends if b.invoker is not None]
-        if not candidates:
+        live = [b for b in backends if b.invoker is not None]
+        if not live:
             raise ConnectionError(f"no live backend for tool {tool_name}")
-        cursor = self._rr.setdefault(tool_name, itertools.count())
-        backend = candidates[next(cursor) % len(candidates)]
+        placeable = [b for b in live if not b.draining]
+        if not placeable:
+            # Draining the LAST replica of a tool leaves nowhere to
+            # place — surface the operational state, don't fabricate a
+            # placement that violates the drain contract.
+            raise ConnectionError(
+                f"all replicas draining for tool {tool_name}"
+            )
+        for b in live:
+            if b.draining:
+                self.router.note_drain_reject(b.target)
+        candidates = [b for b in placeable if b.healthy] or placeable
+        affinity_key = None
+        if self.router.wants_affinity_key and arguments is not None:
+            affinity_key = derive_affinity_key(
+                tool_name, arguments, headers,
+                self.router.cfg.affinity_preamble_bytes,
+            )
+        est_tokens = 0
+        if self.router.wants_prefill_estimate and arguments is not None:
+            est_tokens = estimate_prefill_tokens(arguments)
+        if self.router.policy != "round_robin":
+            # Score-based policies read the snapshot; keep it warm the
+            # same way /metrics does — a background refresh, never an
+            # awaited fan-out on the call path.
+            self._maybe_refresh_serving_stats()
+        backend = self.router.pick(
+            tool_name, candidates,
+            affinity_key=affinity_key, est_prefill_tokens=est_tokens,
+        )
         return method, backend
+
+    def _check_backend_down(self, backend: Backend) -> None:
+        """Chaos hook (utils/failpoints.py `backend_down`): an injected
+        fault here IS a replica dying out from under a routed call —
+        the call fails with the same typed error a dead channel raises
+        and the backend drops out of the candidate set until the
+        watchdog revives it."""
+        try:
+            failpoints.evaluate("backend_down")
+        except failpoints.FailpointError as exc:
+            backend.healthy = False
+            raise ConnectionError(
+                f"backend {backend.target} went down (injected): {exc}"
+            ) from exc
 
     async def invoke_by_tool(
         self,
@@ -367,11 +427,12 @@ class ServiceDiscoverer:
         timeout_s: Optional[float] = None,
     ) -> dict[str, Any]:
         """Route a unary tool call (discovery.go:346-375 parity)."""
-        method, backend = self._route(tool_name)
+        method, backend = self._route(tool_name, arguments, headers)
         if method.is_streaming:
             raise StreamingNotSupportedError(
                 f"tool {tool_name} is streaming; use invoke_stream_by_tool"
             )
+        self._check_backend_down(backend)
         timeout = timeout_s if timeout_s is not None else self.cfg.call_timeout_s
         return await backend.invoker.invoke(method, arguments, headers, timeout)
 
@@ -383,9 +444,10 @@ class ServiceDiscoverer:
         timeout_s: Optional[float] = None,
     ) -> AsyncIterator[dict[str, Any]]:
         """Route a server-streaming tool call (no reference analogue)."""
-        method, backend = self._route(tool_name)
+        method, backend = self._route(tool_name, arguments, headers)
         if method.is_client_streaming:
             raise StreamingNotSupportedError("client streaming not supported")
+        self._check_backend_down(backend)
         timeout = timeout_s if timeout_s is not None else self.cfg.call_timeout_s
         if not method.is_server_streaming:
             yield await backend.invoker.invoke(method, arguments, headers, timeout)
@@ -394,6 +456,40 @@ class ServiceDiscoverer:
             method, arguments, headers, timeout
         ):
             yield chunk
+
+    # -- drain (the operational primitive behind POST /admin/drain) ---------
+
+    def set_draining(self, target: str, draining: bool) -> list[dict[str, Any]]:
+        """Mark one backend (by target, or by its backendN name)
+        draining/undrained. Draining stops NEW placements only:
+        in-flight calls finish untouched, the channel stays connected,
+        rediscovery keeps the tools resolvable via the remaining
+        replicas. Returns the per-backend state list; raises KeyError
+        for an unknown backend."""
+        for backend in self.backends:
+            if target in (backend.target, backend.name):
+                backend.draining = draining
+                logger.warning(
+                    "backend %s %s", backend.target,
+                    "DRAINING (no new placements)" if draining
+                    else "un-drained (restored to candidate set)",
+                )
+                break
+        else:
+            raise KeyError(target)
+        return [
+            {
+                "target": b.target,
+                "healthy": b.healthy,
+                "draining": b.draining,
+            }
+            for b in self.backends
+        ]
+
+    def get_routing_stats(self) -> dict[str, Any]:
+        """Router policy + per-backend placement counters (/stats,
+        /debug/requests, gateway_routing_* metrics)."""
+        return self.router.snapshot()
 
     # -- health / stats -----------------------------------------------------
 
@@ -478,15 +574,22 @@ class ServiceDiscoverer:
                 jobs.append(call(backend, mi))
         return list(await asyncio.gather(*jobs)) if jobs else []
 
-    async def get_serving_stats_snapshot(
-        self, max_age_s: float = 5.0, first_wait_s: float = 0.5
-    ) -> list[dict[str, Any]]:
-        """Last-known ServingStats for the Prometheus scrape path:
-        returns the cached snapshot immediately and refreshes it in the
-        background when older than max_age_s, so scrape latency never
-        couples to backend responsiveness. The very first scrape (no
-        snapshot yet) waits up to first_wait_s for the refresh so a
-        healthy stack doesn't export an empty first sample."""
+    def _stats_view(self) -> tuple[list[dict[str, Any]], float]:
+        """The router's read-only view of the ServingStats snapshot:
+        (entries, age in seconds). Never awaits anything."""
+        if self._serving_stats_at == 0.0:
+            return self._serving_stats_cache, float("inf")
+        return (
+            self._serving_stats_cache,
+            time.monotonic() - self._serving_stats_at,
+        )
+
+    def _maybe_refresh_serving_stats(self, max_age_s: float = 5.0) -> bool:
+        """Spawn the background snapshot refresh when the cache is
+        older than max_age_s (and no refresh is already in flight).
+        Shared by the Prometheus scrape path and the routing hot path —
+        neither ever awaits the fan-out. Returns whether the snapshot
+        was stale."""
         now = time.monotonic()
         stale = now - self._serving_stats_at >= max_age_s
         if stale and (
@@ -508,6 +611,18 @@ class ServiceDiscoverer:
                 self._serving_stats_at = time.monotonic()
 
             self._serving_stats_task = asyncio.create_task(refresh())
+        return stale
+
+    async def get_serving_stats_snapshot(
+        self, max_age_s: float = 5.0, first_wait_s: float = 0.5
+    ) -> list[dict[str, Any]]:
+        """Last-known ServingStats for the Prometheus scrape path:
+        returns the cached snapshot immediately and refreshes it in the
+        background when older than max_age_s, so scrape latency never
+        couples to backend responsiveness. The very first scrape (no
+        snapshot yet) waits up to first_wait_s for the refresh so a
+        healthy stack doesn't export an empty first sample."""
+        self._maybe_refresh_serving_stats(max_age_s)
         if self._serving_stats_at == 0.0 and self._serving_stats_task:
             try:
                 await asyncio.wait_for(
@@ -548,6 +663,7 @@ class ServiceDiscoverer:
                 {
                     "target": b.target,
                     "healthy": b.healthy,
+                    "draining": b.draining,
                     "methodCount": len(b.methods),
                 }
                 for b in self.backends

@@ -2075,7 +2075,8 @@ class ContinuousBatcher:
             **(self.pages.stats() if self._paged else {
                 "kv_pages_total": 0, "kv_pages_in_use": 0,
                 "kv_pages_shared": 0, "paged_prefix_hits": 0,
-                "paged_cow_copies": 0,
+                "paged_cow_copies": 0, "paged_pages_reused": 0,
+                "paged_pages_admitted": 0,
             }),
             # Interleaved (tick-fused) admission activity: chunks
             # piggybacked onto decode ticks / requests admitted that way.

@@ -117,6 +117,7 @@ class PageAllocator:
         self.hits = 0
         self.misses = 0
         self.pages_reused = 0
+        self.pages_admitted = 0
         self.cow_copies = 0
 
     # -- stats ---------------------------------------------------------------
@@ -137,6 +138,13 @@ class PageAllocator:
             "kv_pages_shared": self.shared(),
             "paged_prefix_hits": self.hits,
             "paged_cow_copies": self.cow_copies,
+            # Page-granular reuse: the binary hits counter above says
+            # an admission reused SOMETHING (even a 1-token CoW
+            # overlap); reused/admitted is the honest fraction of
+            # admission pages served from the index — the signal the
+            # replica-routing bench A/Bs (docs/routing.md).
+            "paged_pages_reused": self.pages_reused,
+            "paged_pages_admitted": self.pages_admitted,
         }
 
     # -- prefix index --------------------------------------------------------
@@ -250,9 +258,10 @@ class PageAllocator:
         if cow_page >= 0 and cow_t > 0:
             gather[m] = cow_page
             self.cow_copies += 1
+        self.pages_admitted += w_need
+        self.pages_reused += m
         if m or cow_t:
             self.hits += 1
-            self.pages_reused += m
         elif share:
             self.misses += 1
         return PageAdmission(

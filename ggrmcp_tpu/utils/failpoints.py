@@ -27,6 +27,12 @@ Hook sites (the names the serving plane evaluates):
                  allocator's exhaustion path (typed RESOURCE_EXHAUSTED
                  shed; batching.paged_kv=on only)
   reconnect_fail ServiceDiscoverer._try_reconnect — before dialing
+  backend_down   ServiceDiscoverer.invoke_*_by_tool — after routing,
+                 before the gRPC call: the routed replica "dies" (call
+                 fails typed, Backend.healthy flips False so the router
+                 skips it until the watchdog revives it) — the
+                 replica-kill half of the drain/kill chaos suite
+                 (tests/test_router.py)
 
 Evaluation is cheap when nothing is armed (one dict lookup) and
 deterministic given the call sequence: `every=N` fires on the Nth,

@@ -1,0 +1,701 @@
+"""Replica-routing net (marker `routing`, tier-1): rpc/router.py unit
+properties (rendezvous stability, spill, tie-break determinism,
+stale-stats fallback), discovery-level drain/un-drain membership
+transitions and pick-time health filtering, the backend_down chaos
+scenarios (replica kill mid-burst, graceful drain under load, zero
+calls lost), and the /admin/drain HTTP surface on BOTH http impls.
+"""
+
+import asyncio
+import contextlib
+import logging
+
+import aiohttp
+import pytest
+
+from ggrmcp_tpu.core import config as cfgmod
+from ggrmcp_tpu.core.config import RoutingConfig
+from ggrmcp_tpu.gateway.app import Gateway
+from ggrmcp_tpu.rpc.discovery import ServiceDiscoverer
+from ggrmcp_tpu.rpc.router import (
+    COUNTER_NAMES,
+    ReplicaRouter,
+    derive_affinity_key,
+    estimate_prefill_tokens,
+)
+from ggrmcp_tpu.utils import failpoints
+from tests.backend_utils import InProcessBackend
+
+pytestmark = pytest.mark.routing
+
+TOOL = "hello_helloservice_sayhello"
+
+
+class FakeBackend:
+    """The only surface the router touches is `.target`; the discoverer
+    additionally reads healthy/draining/invoker."""
+
+    def __init__(self, target: str):
+        self.target = target
+        self.healthy = True
+        self.draining = False
+        self.invoker = object()
+
+    def __repr__(self):
+        return f"FakeBackend({self.target})"
+
+
+def make_router(policy="round_robin", entries=None, age_s=0.0, **cfg_kw):
+    cfg = RoutingConfig(policy=policy, **cfg_kw)
+    state = {"entries": entries or [], "age": age_s}
+    router = ReplicaRouter(cfg, stats_view=lambda: (
+        state["entries"], state["age"]
+    ))
+    return router, state
+
+
+def stats_entry(target, queued=0, ttft_sum=0.0, ttft_count=0, **extra):
+    entry = {
+        "target": target,
+        "queuedRequests": queued,
+        "ttftMsSum": ttft_sum,
+        # protojson renders int64 as strings — the router must parse both
+        "ttftMsCount": str(ttft_count),
+    }
+    entry.update(extra)
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# Round-robin + pick-time health filtering
+# ---------------------------------------------------------------------------
+
+
+class TestRoundRobin:
+    def test_per_tool_cursors_cycle(self):
+        router, _ = make_router()
+        pool = [FakeBackend("a"), FakeBackend("b"), FakeBackend("c")]
+        picks = [router.pick("t1", pool).target for _ in range(6)]
+        assert picks == ["a", "b", "c", "a", "b", "c"]
+        # An independent cursor per tool: interleaved multi-tool traffic
+        # must not pin each tool to one replica.
+        assert router.pick("t2", pool).target == "a"
+        assert router.pick("t1", pool).target == "a"
+
+    def test_counters_track_picks(self):
+        router, _ = make_router()
+        pool = [FakeBackend("a"), FakeBackend("b")]
+        for _ in range(4):
+            router.pick("t", pool)
+        snap = router.snapshot()
+        assert snap["policy"] == "round_robin"
+        assert snap["backends"]["a"]["routing_picks"] == 2
+        assert snap["backends"]["b"]["routing_picks"] == 2
+        assert set(snap["backends"]["a"]) == set(COUNTER_NAMES)
+
+    def test_unhealthy_backend_skipped_at_pick_time(self):
+        """Regression: a dead replica must not keep eating every k-th
+        call until rediscovery — candidates are filtered by health at
+        pick time, inside the discoverer's _route."""
+        disc = ServiceDiscoverer(["h1:1", "h2:1"])
+        b1, b2 = disc.backends
+        for b in (b1, b2):
+            b.invoker = object()
+            b.healthy = True
+        disc._tools = {TOOL: (None, [b1, b2])}
+        b2.healthy = False
+        picks = [disc._route(TOOL)[1].target for _ in range(8)]
+        assert set(picks) == {b1.target}
+        b2.healthy = True
+        picks = {disc._route(TOOL)[1].target for _ in range(4)}
+        assert picks == {b1.target, b2.target}
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous (HRW) affinity
+# ---------------------------------------------------------------------------
+
+
+class TestRendezvous:
+    def test_same_key_same_replica_across_membership_churn(self):
+        """The HRW property plain hash%n lacks: removing a replica the
+        key was NOT mapped to never remaps the key."""
+        router, _ = make_router("affinity", spill_threshold=0.0)
+        pool = [FakeBackend(f"r{i}") for i in range(5)]
+        for n in range(64):
+            key = f"session-{n}".encode()
+            chosen = router._hrw(key, pool)
+            for removed in pool:
+                if removed.target == chosen.target:
+                    continue
+                survivors = [b for b in pool if b is not removed]
+                assert router._hrw(key, survivors).target == chosen.target
+
+    def test_keys_spread_over_replicas(self):
+        router, _ = make_router("affinity")
+        pool = [FakeBackend(f"r{i}") for i in range(3)]
+        counts = {b.target: 0 for b in pool}
+        for n in range(300):
+            counts[router._hrw(f"k{n}".encode(), pool).target] += 1
+        # Balanced-ish hashing: no replica starves or hogs.
+        assert all(60 <= c <= 140 for c in counts.values()), counts
+
+    def test_affinity_key_derivation(self):
+        headers = [("X-Session-Id", "abc"), ("x-trace-id", "t")]
+        key = derive_affinity_key("tool", {"prompt": "p"}, headers, 256)
+        assert key == b"s:abc"
+        # No session header: tool + canonical serialized-request preamble.
+        k1 = derive_affinity_key("tool", {"prompt": "same preamble A"}, None, 256)
+        k2 = derive_affinity_key("tool", {"prompt": "same preamble A"}, None, 256)
+        k3 = derive_affinity_key("tool", {"prompt": "other preamble B"}, None, 256)
+        assert k1 == k2
+        assert k1 != k3
+        # Key ordering is canonical: dict insertion order must not matter.
+        ka = derive_affinity_key("t", {"a": 1, "b": 2}, None, 256)
+        kb = derive_affinity_key("t", {"b": 2, "a": 1}, None, 256)
+        assert ka == kb
+        # Beyond the preamble window, differences stop mattering.
+        long_a = {"prompt": "x" * 500 + "tailA"}
+        long_b = {"prompt": "x" * 500 + "tailB"}
+        assert derive_affinity_key("t", long_a, None, 64) == (
+            derive_affinity_key("t", long_b, None, 64)
+        )
+
+    def test_affinity_counts_hits(self):
+        router, _ = make_router("affinity")
+        pool = [FakeBackend("a"), FakeBackend("b")]
+        key = b"s:one"
+        home = router.pick("t", pool, affinity_key=key)
+        for _ in range(5):
+            assert router.pick("t", pool, affinity_key=key).target == home.target
+        snap = router.snapshot()["backends"][home.target]
+        assert snap["affinity_hits"] == 6
+        assert snap["routing_picks"] == 6
+        assert snap["affinity_spills"] == 0
+
+    def test_spill_on_overloaded_home(self):
+        router, state = make_router("affinity", spill_threshold=4.0)
+        pool = [FakeBackend("a"), FakeBackend("b")]
+        key = b"s:x"
+        home = router._hrw(key, pool)
+        other = next(b for b in pool if b is not home)
+        state["entries"] = [
+            stats_entry(home.target, queued=50),
+            stats_entry(other.target, queued=0),
+        ]
+        picked = router.pick("t", pool, affinity_key=key)
+        assert picked.target == other.target
+        counters = router.snapshot()["backends"][home.target]
+        assert counters["affinity_spills"] == 1
+        assert counters["affinity_hits"] == 0
+        # Load drains: the key returns home (affinity is a preference).
+        state["entries"] = [
+            stats_entry(home.target, queued=0),
+            stats_entry(other.target, queued=0),
+        ]
+        assert router.pick("t", pool, affinity_key=key).target == home.target
+
+    def test_spill_threshold_zero_is_strict(self):
+        router, state = make_router("affinity", spill_threshold=0.0)
+        pool = [FakeBackend("a"), FakeBackend("b")]
+        key = b"s:y"
+        home = router._hrw(key, pool)
+        state["entries"] = [
+            stats_entry("a", queued=99), stats_entry("b", queued=99),
+        ]
+        assert router.pick("t", pool, affinity_key=key).target == home.target
+
+    def test_affinity_without_key_uses_load(self):
+        router, state = make_router("affinity")
+        pool = [FakeBackend("a"), FakeBackend("b")]
+        state["entries"] = [
+            stats_entry("a", queued=9), stats_entry("b", queued=0),
+        ]
+        assert router.pick("t", pool, affinity_key=None).target == "b"
+
+
+# ---------------------------------------------------------------------------
+# Least-loaded scoring
+# ---------------------------------------------------------------------------
+
+
+class TestLeastLoaded:
+    def test_picks_smallest_queue(self):
+        router, state = make_router("least_loaded")
+        pool = [FakeBackend("a"), FakeBackend("b"), FakeBackend("c")]
+        state["entries"] = [
+            stats_entry("a", queued=3),
+            stats_entry("b", queued=1),
+            stats_entry("c", queued=7),
+        ]
+        for _ in range(3):  # no cursor advance on the scored path
+            assert router.pick("t", pool).target == "b"
+
+    def test_ewma_ttft_breaks_equal_queues(self):
+        router, state = make_router("least_loaded")
+        pool = [FakeBackend("a"), FakeBackend("b")]
+        state["entries"] = [
+            stats_entry("a", queued=1, ttft_sum=50_000.0, ttft_count=100),
+            stats_entry("b", queued=1, ttft_sum=1_000.0, ttft_count=100),
+        ]
+        assert router.pick("t", pool).target == "b"
+
+    def test_tie_break_is_deterministic(self):
+        router, state = make_router("least_loaded")
+        pool = [FakeBackend("zz"), FakeBackend("aa"), FakeBackend("mm")]
+        state["entries"] = [stats_entry(b.target, queued=2) for b in pool]
+        picks = {router.pick("t", pool).target for _ in range(5)}
+        assert picks == {"aa"}  # (score, target) ordering, stable
+
+    def test_stale_stats_fall_back_to_round_robin(self, caplog):
+        router, state = make_router(
+            "least_loaded", age_s=1e9, stale_stats_max_age_s=30.0,
+            entries=[stats_entry("a", queued=0), stats_entry("b", queued=9)],
+        )
+        pool = [FakeBackend("a"), FakeBackend("b")]
+        with caplog.at_level(logging.WARNING, logger="ggrmcp.rpc.router"):
+            picks = [router.pick("t", pool).target for _ in range(4)]
+        assert picks == ["a", "b", "a", "b"]  # round-robin, not a stall
+        stale_warnings = [
+            r for r in caplog.records if "degrades to round-robin" in r.message
+        ]
+        assert len(stale_warnings) == 1  # loud, but once per episode
+        # Snapshot recovers → scoring resumes (and the latch resets).
+        state["age"] = 0.0
+        assert router.pick("t", pool).target == "a"
+
+    def test_no_stats_at_all_falls_back(self):
+        router, _ = make_router("least_loaded", entries=[])
+        pool = [FakeBackend("a"), FakeBackend("b")]
+        picks = [router.pick("t", pool).target for _ in range(4)]
+        assert picks == ["a", "b", "a", "b"]
+
+    def test_backend_restart_resets_ewma(self):
+        router, state = make_router("least_loaded")
+        pool = [FakeBackend("a"), FakeBackend("b")]
+        state["entries"] = [
+            stats_entry("a", queued=0, ttft_sum=90_000.0, ttft_count=100),
+            stats_entry("b", queued=0, ttft_sum=10_000.0, ttft_count=100),
+        ]
+        assert router.pick("t", pool).target == "b"
+        # "a" restarts: cumulative counters reset below the high-water
+        # mark — the router must re-anchor, not compute a negative window.
+        state["entries"] = [
+            stats_entry("a", queued=0, ttft_sum=10.0, ttft_count=2),
+            stats_entry("b", queued=0, ttft_sum=100.0, ttft_count=100),
+        ]
+        assert router.pick("t", pool).target == "a"
+
+
+# ---------------------------------------------------------------------------
+# Experimental prefill steering
+# ---------------------------------------------------------------------------
+
+
+class TestSteering:
+    @staticmethod
+    def phase_entry(target, admit_ms, other_ms, queued=0):
+        return stats_entry(
+            target, queued=queued,
+            tickPhaseAdmitMs=admit_ms, tickPhaseDispatchMs=other_ms,
+            tickPhaseSyncMs=0.0, tickPhaseWaitMs=0.0, tickPhaseHostMs=0.0,
+        )
+
+    def test_long_prefill_prefers_prefill_light_replica(self):
+        router, state = make_router(
+            "least_loaded", steer_prefill="on", steer_prefill_min_tokens=100,
+        )
+        pool = [FakeBackend("heavy"), FakeBackend("light")]
+        state["entries"] = [
+            # Equal queues; "heavy" spends most tick time in admit
+            # (prefill), "light" in dispatch — the long request must
+            # land on "light" even though scores tie (and "heavy"
+            # would win the lexicographic tie-break).
+            self.phase_entry("heavy", admit_ms=900.0, other_ms=100.0),
+            self.phase_entry("light", admit_ms=100.0, other_ms=900.0),
+        ]
+        assert router.pick("t", pool, est_prefill_tokens=5000).target == "light"
+        # Short requests are not steered: tie-break applies as usual.
+        assert router.pick("t", pool, est_prefill_tokens=10).target == "heavy"
+
+    def test_steering_off_by_default(self):
+        router, state = make_router("least_loaded")
+        assert not router.wants_prefill_estimate
+        pool = [FakeBackend("heavy"), FakeBackend("light")]
+        state["entries"] = [
+            self.phase_entry("heavy", admit_ms=900.0, other_ms=100.0),
+            self.phase_entry("light", admit_ms=100.0, other_ms=900.0),
+        ]
+        assert router.pick("t", pool, est_prefill_tokens=5000).target == "heavy"
+
+    def test_estimate(self):
+        assert estimate_prefill_tokens({"prompt": "abcd"}) == 4
+        assert estimate_prefill_tokens({"no": "prompt"}) > 0
+        assert estimate_prefill_tokens(None) == 0
+
+
+# ---------------------------------------------------------------------------
+# Drain membership transitions (discoverer level)
+# ---------------------------------------------------------------------------
+
+
+class TestDrainMembership:
+    def make_disc(self):
+        from types import SimpleNamespace
+
+        disc = ServiceDiscoverer(["h1:1", "h2:1"])
+        for b in disc.backends:
+            b.invoker = object()
+            b.healthy = True
+        mi = SimpleNamespace(
+            service_name="hello.HelloService", is_streaming=False
+        )
+        disc._tools = {TOOL: (mi, list(disc.backends))}
+        return disc
+
+    def test_drain_excludes_and_undrain_restores(self):
+        disc = self.make_disc()
+        b1, b2 = disc.backends
+        state = disc.set_draining(b2.target, True)
+        assert state == [
+            {"target": b1.target, "healthy": True, "draining": False},
+            {"target": b2.target, "healthy": True, "draining": True},
+        ]
+        picks = [disc._route(TOOL)[1].target for _ in range(6)]
+        assert set(picks) == {b1.target}
+        counters = disc.get_routing_stats()["backends"]
+        assert counters[b2.target]["drain_rejects"] == 6
+        assert counters[b2.target].get("routing_picks", 0) == 0
+        disc.set_draining(b2.target, False)
+        picks = {disc._route(TOOL)[1].target for _ in range(4)}
+        assert picks == {b1.target, b2.target}
+
+    def test_drain_all_replicas_raises(self):
+        disc = self.make_disc()
+        for b in disc.backends:
+            disc.set_draining(b.target, True)
+        with pytest.raises(ConnectionError, match="draining"):
+            disc._route(TOOL)
+
+    def test_drain_unknown_backend_raises(self):
+        disc = self.make_disc()
+        with pytest.raises(KeyError):
+            disc.set_draining("nope:99", True)
+
+    def test_drain_beats_unhealthy_fallback(self):
+        """The all-unhealthy last-resort fallback must still respect
+        drain: a drained backend takes no new placements even when
+        every replica is unhealthy."""
+        disc = self.make_disc()
+        b1, b2 = disc.backends
+        b1.healthy = False
+        b2.healthy = False
+        disc.set_draining(b2.target, True)
+        picks = {disc._route(TOOL)[1].target for _ in range(4)}
+        assert picks == {b1.target}
+
+    def test_service_stats_carry_drain_state(self):
+        disc = self.make_disc()
+        disc.set_draining(disc.backends[1].target, True)
+        stats = disc.get_service_stats()
+        assert [b["draining"] for b in stats["backends"]] == [False, True]
+
+
+# ---------------------------------------------------------------------------
+# Chaos: replica kill + graceful drain under load (real gRPC backends)
+# ---------------------------------------------------------------------------
+
+
+@contextlib.asynccontextmanager
+async def two_replica_env(policy="round_robin"):
+    async with InProcessBackend() as b1:
+        b2 = InProcessBackend()
+        await b2.__aenter__()
+        disc = ServiceDiscoverer(
+            [b1.target, b2.target],
+            cfgmod.GRPCConfig(connect_timeout_s=5.0),
+            routing=RoutingConfig(policy=policy),
+        )
+        await disc.connect()
+        await disc.discover_services()
+        try:
+            yield b1, b2, disc
+        finally:
+            await disc.close()
+            with contextlib.suppress(Exception):
+                await b2.__aexit__()
+
+
+@pytest.mark.chaos
+class TestChaosKillAndDrain:
+    async def test_backend_down_failpoint_fails_over(self):
+        """The injected replica death: exactly one routed call fails
+        typed, the backend leaves the candidate set, every subsequent
+        call lands on the survivor."""
+        async with two_replica_env() as (_b1, _b2, disc):
+            failpoints.registry.arm("backend_down", every=3, times=1)
+            try:
+                errors = []
+                for i in range(12):
+                    try:
+                        result = await disc.invoke_by_tool(
+                            TOOL, {"name": f"c{i}"}
+                        )
+                        assert result["message"] == f"Hello, c{i}!"
+                    except ConnectionError as exc:
+                        errors.append(str(exc))
+                assert len(errors) == 1
+                assert "went down (injected)" in errors[0]
+                dead = [b for b in disc.backends if not b.healthy]
+                assert len(dead) == 1
+                survivor = next(b for b in disc.backends if b.healthy)
+                for _ in range(4):
+                    assert disc._route(TOOL)[1] is survivor
+            finally:
+                failpoints.registry.disarm()
+
+    async def test_replica_kill_mid_burst(self):
+        """Kill one of two replicas mid-burst: in-flight calls on the
+        dead replica surface typed errors (never hangs, never silent
+        loss), new calls route to the survivor."""
+        async with two_replica_env() as (_b1, b2, disc):
+            async def call(i):
+                return await disc.invoke_by_tool(TOOL, {"name": f"k{i}"})
+
+            burst = [asyncio.create_task(call(i)) for i in range(24)]
+            await b2.server.stop(grace=None)  # mid-burst kill
+            results = await asyncio.gather(*burst, return_exceptions=True)
+            ok = [r for r in results if isinstance(r, dict)]
+            failed = [r for r in results if isinstance(r, BaseException)]
+            # Every call terminated, each either correct or typed.
+            assert len(ok) + len(failed) == 24
+            for r in ok:
+                assert r["message"].startswith("Hello, k")
+            import grpc
+
+            for exc in failed:
+                assert isinstance(exc, (grpc.RpcError, ConnectionError))
+            # The watchdog's job, done inline: flag the dead replica.
+            for backend in disc.backends:
+                if backend.target == b2.target:
+                    backend.healthy = False
+            for i in range(6):
+                result = await disc.invoke_by_tool(TOOL, {"name": f"n{i}"})
+                assert result["message"] == f"Hello, n{i}!"
+
+    async def test_graceful_drain_under_load_zero_lost(self):
+        """The drain contract: in-flight calls finish bit-identically,
+        the drained replica takes zero new placements, un-drain
+        restores it — zero calls lost end to end."""
+        async with two_replica_env() as (_b1, b2, disc):
+            async def call(i):
+                return await disc.invoke_by_tool(TOOL, {"name": f"d{i}"})
+
+            in_flight = [asyncio.create_task(call(i)) for i in range(32)]
+            disc.set_draining(b2.target, True)  # mid-burst drain
+            results = await asyncio.gather(*in_flight)
+            # Zero lost, bit-identical payloads.
+            assert [r["message"] for r in results] == [
+                f"Hello, d{i}!" for i in range(32)
+            ]
+            picks_before = disc.get_routing_stats()["backends"].get(
+                b2.target, {}
+            ).get("routing_picks", 0)
+            for i in range(8):
+                result = await disc.invoke_by_tool(TOOL, {"name": f"p{i}"})
+                assert result["message"] == f"Hello, p{i}!"
+            after = disc.get_routing_stats()["backends"]
+            assert after[b2.target]["routing_picks"] == picks_before
+            assert after[b2.target]["drain_rejects"] >= 8
+            disc.set_draining(b2.target, False)
+            seen = set()
+            for i in range(8):
+                seen.add(disc._route(TOOL)[1].target)
+            assert b2.target in seen  # restored to the candidate set
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: /admin/drain + routing counters, BOTH impls
+# ---------------------------------------------------------------------------
+
+
+@contextlib.asynccontextmanager
+async def routed_gateway(impl: str, policy: str = "round_robin"):
+    async with InProcessBackend() as b1:
+        b2 = InProcessBackend()
+        await b2.__aenter__()
+        cfg = cfgmod.default()
+        cfg.server.host = "127.0.0.1"
+        cfg.server.port = 0
+        cfg.server.http_impl = impl
+        cfg.grpc.connect_timeout_s = 5.0
+        cfg.grpc.reconnect.enabled = False
+        cfg.gateway.routing.policy = policy
+        gw = Gateway(cfg, targets=[b1.target, b2.target])
+        await gw.start()
+        base = f"http://127.0.0.1:{gw.port}"
+        async with aiohttp.ClientSession(base_url=base) as client:
+            try:
+                yield b1, b2, gw, client
+            finally:
+                await gw.stop()
+                with contextlib.suppress(Exception):
+                    await b2.__aexit__()
+
+
+async def tool_call(client, i=0):
+    return await client.post("/", json={
+        "jsonrpc": "2.0", "method": "tools/call", "id": i,
+        "params": {"name": TOOL, "arguments": {"name": f"h{i}"}},
+    })
+
+
+@pytest.mark.parametrize("impl", ["fastlane", "aiohttp"])
+class TestAdminDrainHTTP:
+    async def test_drain_undrain_roundtrip(self, impl):
+        async with routed_gateway(impl) as (_b1, b2, gw, client):
+            resp = await client.post(f"/admin/drain?backend={b2.target}")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["draining"] is True
+            assert any(
+                b["target"] == b2.target and b["draining"]
+                for b in body["backends"]
+            )
+            # Tools stay servable through the remaining replica; the
+            # drained backend takes no placements.
+            for i in range(6):
+                resp = await tool_call(client, i)
+                data = await resp.json()
+                assert not data["result"].get("isError", False)
+            routing = (await (await client.get("/stats")).json())["routing"]
+            assert routing["backends"][b2.target]["routing_picks"] == 0
+            assert routing["backends"][b2.target]["drain_rejects"] >= 6
+            # /stats backends carry the drain state for dashboards.
+            stats = await (await client.get("/stats")).json()
+            assert any(
+                b["target"] == b2.target and b["draining"]
+                for b in stats["backends"]
+            )
+            resp = await client.post(f"/admin/undrain?backend={b2.target}")
+            assert (await resp.json())["draining"] is False
+            for i in range(8):
+                await tool_call(client, 10 + i)
+            routing = (await (await client.get("/stats")).json())["routing"]
+            assert routing["backends"][b2.target]["routing_picks"] > 0
+
+    async def test_drain_validation(self, impl):
+        async with routed_gateway(impl) as (_b1, _b2, _gw, client):
+            resp = await client.post("/admin/drain")
+            assert resp.status == 400
+            resp = await client.post("/admin/drain?backend=nope:1")
+            assert resp.status == 404
+            assert "backends" in await resp.json()
+            resp = await client.get("/admin/drain")
+            assert resp.status == 405
+
+    async def test_routing_counters_exported(self, impl):
+        async with routed_gateway(impl) as (_b1, _b2, _gw, client):
+            for i in range(4):
+                await tool_call(client, i)
+            payload = await (await client.get("/metrics")).read()
+            assert b"gateway_routing_picks{" in payload
+            assert b'gateway_routing_policy_info{policy="round_robin"}' in payload
+            # /debug/requests surfaces the same snapshot.
+            body = await (await client.get("/debug/requests")).json()
+            assert body["routing"]["policy"] == "round_robin"
+            assert sum(
+                c["routing_picks"]
+                for c in body["routing"]["backends"].values()
+            ) == 4
+
+
+class TestAffinityEndToEnd:
+    async def test_session_header_pins_replica(self):
+        async with routed_gateway("fastlane", policy="affinity") as (
+            _b1, _b2, gw, client
+        ):
+            for i in range(6):
+                resp = await client.post("/", json={
+                    "jsonrpc": "2.0", "method": "tools/call", "id": i,
+                    "params": {
+                        "name": TOOL, "arguments": {"name": f"a{i}"}
+                    },
+                }, headers={"x-session-id": "sess-42"})
+                data = await resp.json()
+                assert not data["result"].get("isError", False)
+            routing = gw.discoverer.get_routing_stats()
+            counters = routing["backends"]
+            # One session key → one home replica, every call an
+            # affinity hit (nothing is overloaded).
+            homes = [
+                t for t, c in counters.items() if c["routing_picks"] > 0
+            ]
+            assert len(homes) == 1
+            assert counters[homes[0]]["affinity_hits"] == 6
+            assert counters[homes[0]]["routing_picks"] == 6
+
+    async def test_distinct_preambles_spread(self):
+        """No session header: the serialized-request preamble is the
+        key — many distinct preambles should use both replicas."""
+        async with routed_gateway("fastlane", policy="affinity") as (
+            _b1, _b2, gw, client
+        ):
+            for i in range(16):
+                resp = await client.post("/", json={
+                    "jsonrpc": "2.0", "method": "tools/call", "id": i,
+                    "params": {
+                        "name": TOOL,
+                        "arguments": {"name": f"preamble-{i:04d}"},
+                    },
+                })
+                data = await resp.json()
+                assert not data["result"].get("isError", False)
+            counters = gw.discoverer.get_routing_stats()["backends"]
+            used = [t for t, c in counters.items() if c["routing_picks"] > 0]
+            assert len(used) == 2
+
+
+# ---------------------------------------------------------------------------
+# Config validation
+# ---------------------------------------------------------------------------
+
+
+class TestRoutingConfig:
+    def test_defaults_validate(self):
+        cfgmod.default().validate()
+
+    @pytest.mark.parametrize("field,value,match", [
+        ("policy", "weighted", "unknown gateway.routing.policy"),
+        ("affinity_preamble_bytes", 0, "affinity_preamble_bytes"),
+        ("spill_threshold", -1.0, "spill_threshold"),
+        ("steer_prefill", "maybe", "steer_prefill"),
+        ("steer_prefill_min_tokens", 0, "steer_prefill_min_tokens"),
+        ("stale_stats_max_age_s", 0.0, "stale_stats_max_age_s"),
+    ])
+    def test_typed_errors(self, field, value, match):
+        cfg = cfgmod.default()
+        setattr(cfg.gateway.routing, field, value)
+        with pytest.raises(ValueError, match=match):
+            cfg.validate()
+
+    def test_env_override_path(self):
+        cfg = cfgmod.default()
+        cfgmod.apply_env(cfg, {
+            "GGRMCP_GATEWAY_ROUTING_POLICY": "affinity",
+            "GGRMCP_GATEWAY_ROUTING_SPILL_THRESHOLD": "2.5",
+        })
+        assert cfg.gateway.routing.policy == "affinity"
+        assert cfg.gateway.routing.spill_threshold == 2.5
+        cfg.validate()
+
+    def test_router_rejects_unknown_policy(self):
+        with pytest.raises(ValueError, match="unknown routing policy"):
+            ReplicaRouter(RoutingConfig(policy="nope"))
+
+    def test_round_robin_derives_no_keys(self):
+        """Bitwise behavior-compatibility: the default policy must not
+        pay per-call key derivation (json.dumps) on the hot path."""
+        router = ReplicaRouter(RoutingConfig())
+        assert not router.wants_affinity_key
+        assert not router.wants_prefill_estimate
