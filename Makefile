@@ -7,7 +7,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: proto proto-check descriptors test test-all test-fast test-chaos \
   test-obs test-grammar test-spec-batch test-paged test-tp test-analysis \
-  bench-cpu smoke e2e lint graftlint ci-local preflight clean
+  test-disagg bench-cpu smoke e2e lint graftlint ci-local preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
 # No protoc on this image? scripts/regen_serving_pb2.py regenerates
@@ -128,6 +128,9 @@ test-routing:
 
 test-analysis:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m analysis
+
+test-disagg:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m disagg
 
 # ruff if present (baked CI image installs it; the TPU image may not).
 lint:

@@ -96,12 +96,29 @@ Environment knobs:
                          GGRMCP_BENCH_REPLICA_PAGES (192 — sized so
                          sprayed placement thrashes the per-replica
                          page index while an affinity share fits).
+  GGRMCP_BENCH_DISAGG=1  disaggregated prefill/decode phase (standalone
+                         mode, like REPLICAS): a 2-replica prefill+
+                         decode split (serving.role, page-granular KV
+                         shipping over TransferKV) vs the mixed fleet
+                         at EQUAL replica count (round_robin and
+                         least_loaded points), over a mixed long+short
+                         workload — exports aggregate calls/s and
+                         tokens/s, backend TTFT p99 from the real
+                         histograms, decode-stall max, and the
+                         transfer-plane counters (docs/routing.md
+                         role-split table). Knobs:
+                         GGRMCP_BENCH_DISAGG_SHORT_CALLS (96),
+                         GGRMCP_BENCH_DISAGG_LONG_CALLS (10),
+                         GGRMCP_BENCH_DISAGG_LONG_LEN (1200 tokens),
+                         GGRMCP_BENCH_DISAGG_SHORT_WORKERS (6),
+                         GGRMCP_BENCH_DISAGG_LONG_WORKERS (2).
   GGRMCP_BENCH_CPU=1     force the CPU platform (tiny model)
 """
 
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 import os
 import statistics
@@ -1935,11 +1952,16 @@ async def _replica_worker() -> None:
 
     serving = ServingConfig(
         model=os.environ.get("GGRMCP_BENCH_REPLICA_MODEL", "tiny-llama"),
+        # Disagg phase: the parent assigns each replica its role
+        # (prefill | decode | mixed); the routing phase leaves "mixed".
+        role=os.environ.get("GGRMCP_BENCH_REPLICA_ROLE", "mixed"),
         batching=BatchingConfig(
             max_batch_size=int(
                 os.environ.get("GGRMCP_BENCH_REPLICA_SLOTS", "4")
             ),
-            kv_cache_max_seq=512,
+            kv_cache_max_seq=int(
+                os.environ.get("GGRMCP_BENCH_REPLICA_MAXSEQ", "512")
+            ),
             decode_steps_per_tick=1,
             # The phase exists to show placement protecting the paged
             # page index: the 192-page arena cannot hold the
@@ -2232,6 +2254,271 @@ async def _replica_bench(n_replicas: int) -> dict:
     }
 
 
+async def _disagg_bench() -> dict:
+    """Prefill/decode disaggregation vs the best mixed fleet at EQUAL
+    replica count (ROADMAP item 1, docs/routing.md role-split table).
+
+    Three 2-replica points over the same mixed long+short workload
+    (short decode-ish calls racing occasional long-prompt admissions —
+    the interference shape DistServe exists for):
+
+      1. mixed fleet, round_robin   — the default config.
+      2. mixed fleet, least_loaded  — the strongest role-less config
+         for this unsessioned workload (affinity has no key to pin on).
+      3. prefill+decode split       — long prompts prefill on the
+         prefill replica and ship their KV pages (TransferKV) to the
+         decode replica, whose short traffic never shares a tick with
+         a long admission again.
+
+    Honest-table contract: every point exports aggregate calls/s and
+    tokens/s, backend TTFT p99 (from the true ServingStats histograms,
+    summed across replicas), and decode-stall max — committed to
+    docs/BENCH.md whether the split wins or not. Long prompts are
+    DISTINCT per call (no prefix aliasing), so the mixed fleet's number
+    is not handicapped by cache effects the split doesn't also get."""
+    import logging
+
+    logging.getLogger("ggrmcp.gateway.http").setLevel(logging.WARNING)
+    import aiohttp
+
+    from ggrmcp_tpu.core import config as cfgmod
+    from ggrmcp_tpu.gateway.app import Gateway
+
+    short_calls = int(
+        os.environ.get("GGRMCP_BENCH_DISAGG_SHORT_CALLS", "96")
+    )
+    long_calls = int(os.environ.get("GGRMCP_BENCH_DISAGG_LONG_CALLS", "10"))
+    short_workers = int(
+        os.environ.get("GGRMCP_BENCH_DISAGG_SHORT_WORKERS", "6")
+    )
+    long_workers = int(
+        os.environ.get("GGRMCP_BENCH_DISAGG_LONG_WORKERS", "2")
+    )
+    long_len = int(os.environ.get("GGRMCP_BENCH_DISAGG_LONG_LEN", "1200"))
+    max_seq = 2048
+    min_tokens = max(64, long_len // 2)  # disagg threshold under the prompt
+    max_new = 8
+    tool = "ggrmcp_tpu_generateservice_generate"
+
+    def short_prompt(tag: str, i: int) -> str:
+        return f"{tag} short call {i}: summarize ticket {i * 17}."
+
+    def long_prompt(tag: str, i: int) -> str:
+        # Distinct per call (tag+i in the head) so no point ever skips
+        # a prefill via prefix reuse — the split must win on placement,
+        # not on cache aliasing.
+        body = f"{tag} doc {i} " + ("lorem ipsum kv page shipping " * 64)
+        return body[:long_len]
+
+    def ttft_p99(stats0: dict, stats1: dict) -> float:
+        """p99 TTFT upper bound from the run's histogram delta, summed
+        across replicas (fixed shared bounds make the buckets
+        mergeable — the whole point of exporting true histograms)."""
+        bounds: list[float] = []
+        counts: list[int] = []
+        for t, after in stats1.items():
+            b = [float(x) for x in after.get("latencyBucketBoundsMs", [])]
+            if not b:
+                continue
+            raw1 = [int(float(c)) for c in after.get("ttftMsBucket", [])]
+            raw0 = [
+                int(float(c))
+                for c in stats0.get(t, {}).get("ttftMsBucket", [])
+            ] or [0] * len(raw1)
+            if not raw1:
+                continue
+            delta = [a - b0 for a, b0 in zip(raw1, raw0)]
+            if not bounds:
+                bounds = b
+                counts = [0] * (len(b) + 1)
+            for j, c in enumerate(delta[: len(counts)]):
+                counts[j] += c
+        total = sum(counts)
+        if not total:
+            return 0.0
+        rank = -(-99 * total // 100)  # ceil nearest-rank
+        cum = 0
+        for j, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                return bounds[j] if j < len(bounds) else float("inf")
+        return bounds[-1]
+
+    def stat(entry: dict, key: str) -> float:
+        try:
+            return float(entry.get(key, 0))
+        except (TypeError, ValueError):
+            return 0.0
+
+    async def spawn(roles: list[str]):
+        workers, targets = [], []
+        for role in roles:
+            env = {
+                **os.environ, "GGRMCP_BENCH_REPLICA_WORKER": "1",
+                "JAX_PLATFORMS": "cpu",
+                "GGRMCP_BENCH_REPLICA_ROLE": role,
+                "GGRMCP_BENCH_REPLICA_MAXSEQ": str(max_seq),
+                "GGRMCP_BENCH_REPLICA_PAGES": "0",  # auto-size the arena
+            }
+            workers.append(await asyncio.create_subprocess_exec(
+                sys.executable, os.path.abspath(__file__), env=env,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.DEVNULL,
+            ))
+        for w in workers:
+            line = await asyncio.wait_for(w.stdout.readline(), timeout=600)
+            text = line.decode().strip()
+            if not text.startswith("TARGET="):
+                raise RuntimeError(f"disagg worker not ready: {text!r}")
+            targets.append(text.removeprefix("TARGET="))
+        return workers, targets
+
+    async def measure(policy: str, roles: list[str], tag: str) -> dict:
+        workers, targets = await spawn(roles)
+        try:
+            cfg = cfgmod.default()
+            cfg.server.host = "127.0.0.1"
+            cfg.server.port = 0
+            cfg.server.rate_limit.enabled = False
+            cfg.session.rate_limit.enabled = False
+            cfg.grpc.reconnect.enabled = False
+            cfg.server.request_timeout_s = 600.0
+            cfg.grpc.call_timeout_s = 600.0
+            cfg.gateway.routing.policy = policy
+            cfg.gateway.routing.disagg_min_prompt_tokens = min_tokens
+            gateway = Gateway(cfg, targets=targets)
+            await gateway.start()
+            base = f"http://127.0.0.1:{gateway.port}"
+            short_lat: list[float] = []
+            long_lat: list[float] = []
+            try:
+                async with aiohttp.ClientSession(base_url=base) as client:
+                    async def call(prompt: str, rid: int) -> float:
+                        body = {
+                            "jsonrpc": "2.0", "method": "tools/call",
+                            "id": rid,
+                            "params": {"name": tool, "arguments": {
+                                "prompt": prompt, "maxNewTokens": max_new,
+                            }},
+                        }
+                        t0 = time.perf_counter()
+                        resp = await client.post("/", json=body)
+                        data = await resp.json()
+                        if "error" in data:
+                            raise RuntimeError(
+                                f"disagg bench call failed: {data['error']}"
+                            )
+                        return (time.perf_counter() - t0) * 1000.0
+
+                    # Warm every compile bucket (and the transfer path)
+                    # off the measured clock.
+                    for i in range(2 * len(targets)):
+                        await call(short_prompt(f"warm-{tag}", 9000 + i),
+                                   90000 + i)
+                    await call(long_prompt(f"warm-{tag}", 0), 90100)
+                    await asyncio.gather(*(
+                        call(short_prompt(f"warmb-{tag}", i), 90200 + i)
+                        for i in range(4)
+                    ))
+
+                    disc = gateway.discoverer
+                    stats0 = {
+                        e["target"]: e
+                        for e in await disc.get_backend_serving_stats()
+                        if "error" not in e
+                    }
+                    next_short = itertools.count()
+                    next_long = itertools.count()
+
+                    async def short_loop() -> None:
+                        while (i := next(next_short)) < short_calls:
+                            short_lat.append(
+                                await call(short_prompt(tag, i), 1000 + i)
+                            )
+
+                    async def long_loop() -> None:
+                        while (i := next(next_long)) < long_calls:
+                            long_lat.append(
+                                await call(long_prompt(tag, i), 5000 + i)
+                            )
+
+                    t_start = time.perf_counter()
+                    await asyncio.gather(
+                        *(short_loop() for _ in range(short_workers)),
+                        *(long_loop() for _ in range(long_workers)),
+                    )
+                    elapsed = time.perf_counter() - t_start
+                    stats1 = {
+                        e["target"]: e
+                        for e in await disc.get_backend_serving_stats()
+                        if "error" not in e
+                    }
+                routing = disc.get_routing_stats()["backends"]
+            finally:
+                await gateway.stop()
+            calls = len(short_lat) + len(long_lat)
+            tokens = (
+                short_calls * max_new + long_calls * max_new
+            )
+            return {
+                "policy": policy,
+                "roles": "+".join(roles),
+                "calls_per_sec": round(calls / elapsed, 2),
+                "tokens_per_sec": round(tokens / elapsed, 1),
+                "short_p50_ms": round(statistics.median(short_lat), 1),
+                "short_p99_ms": round(nearest_rank(short_lat, 0.99), 1),
+                "long_p99_ms": round(nearest_rank(long_lat, 0.99), 1),
+                "ttft_p99_ms_le": ttft_p99(stats0, stats1),
+                "decode_stall_ms_max": max(
+                    (stat(e, "decodeStallMsMax") for e in stats1.values()),
+                    default=0.0,
+                ),
+                "disagg_prefills": sum(
+                    c.get("disagg_prefills", 0) for c in routing.values()
+                ),
+                "disagg_fallbacks": sum(
+                    c.get("disagg_fallbacks", 0) for c in routing.values()
+                ),
+                "kv_transfer_pages": sum(
+                    int(stat(e, "kvTransferPagesSent"))
+                    for e in stats1.values()
+                ),
+            }
+        finally:
+            for w in workers:
+                if w.returncode is None:
+                    w.kill()
+            for w in workers:
+                await w.wait()
+
+    mixed_rr = await measure("round_robin", ["mixed", "mixed"], "mrr")
+    mixed_ll = await measure("least_loaded", ["mixed", "mixed"], "mll")
+    split = await measure("round_robin", ["prefill", "decode"], "split")
+    best_mixed = max(
+        (mixed_rr, mixed_ll), key=lambda p: p["calls_per_sec"]
+    )
+    return {
+        "disagg_long_len": long_len,
+        "disagg_short_calls": short_calls,
+        "disagg_long_calls": long_calls,
+        "disagg_mixed_rr": mixed_rr,
+        "disagg_mixed_ll": mixed_ll,
+        "disagg_split": split,
+        "disagg_best_mixed_policy": best_mixed["policy"],
+        # Headline comparisons, committed honest either way.
+        "disagg_split_speedup_tokens": round(
+            split["tokens_per_sec"] / best_mixed["tokens_per_sec"], 3
+        ) if best_mixed["tokens_per_sec"] else 0.0,
+        "disagg_split_ttft_p99_ratio": round(
+            split["ttft_p99_ms_le"] / best_mixed["ttft_p99_ms_le"], 3
+        ) if best_mixed["ttft_p99_ms_le"] else 0.0,
+        "disagg_split_stall_ratio": round(
+            split["decode_stall_ms_max"]
+            / best_mixed["decode_stall_ms_max"], 3
+        ) if best_mixed["decode_stall_ms_max"] else 0.0,
+    }
+
+
 _ARTIFACT_DIR = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "bench_artifacts"
 )
@@ -2388,6 +2675,18 @@ def main() -> None:
             "metric": "replica_aggregate_calls_per_sec",
             "value": result["replica_aff_calls_per_sec"],
             "unit": "calls/s", **result,
+        }))
+        return
+
+    if os.environ.get("GGRMCP_BENCH_DISAGG") == "1":
+        # Standalone disaggregation phase (like REPLICAS): prefill/
+        # decode split vs the best mixed fleet at equal replica count,
+        # CPU host processes by design.
+        result = asyncio.run(_disagg_bench())
+        _emit(json.dumps({
+            "metric": "disagg_split_tokens_per_sec",
+            "value": result["disagg_split"]["tokens_per_sec"],
+            "unit": "tokens/s", **result,
         }))
         return
 

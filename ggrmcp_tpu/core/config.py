@@ -511,6 +511,16 @@ class GrammarConfig:
 # of truth for config.validate() and rpc/router.py.
 ROUTING_POLICIES = ("round_robin", "least_loaded", "affinity")
 
+# Replica roles (serving.role) — the single source of truth for
+# config.validate(), the sidecar, and the role-aware router
+# (docs/routing.md). "mixed" is today's behavior bit-for-bit; "prefill"
+# replicas take long-prompt admissions and ship the finished prompt's
+# KV pages to a decode replica (sidecar→sidecar TransferKV); "decode"
+# replicas admit those requests with pre-populated pages and skip
+# prefill entirely (DistServe-style disaggregation, Zhong et al.
+# OSDI'24, over Mooncake-style page shipping).
+SERVING_ROLES = ("mixed", "prefill", "decode")
+
 
 @dataclass
 class RoutingConfig:
@@ -545,15 +555,30 @@ class RoutingConfig:
     # (score units: 1.0 per queued request + EWMA TTFT / 100 ms).
     # 0 disables spilling (strict affinity).
     spill_threshold: float = 8.0
-    # EXPERIMENTAL (off by default): steer requests whose estimated
-    # prefill work exceeds steer_prefill_min_tokens toward replicas
-    # whose cumulative tick-phase attribution shows the smallest
-    # admit-phase (prefill) share — a cheap, signal-driven
-    # approximation of prefill/decode disaggregation using PR 9's
-    # phase scalars (docs/routing.md caveats). Only consulted when no
-    # affinity key applies; cache locality outranks steering.
+    # DEPRECATED heuristic (off by default), superseded by real
+    # prefill/decode disaggregation (serving.role + the disagg knobs
+    # below): steer requests whose estimated prefill work exceeds
+    # steer_prefill_min_tokens toward replicas whose cumulative
+    # tick-phase attribution shows the smallest admit-phase (prefill)
+    # share. Only consulted when no affinity key applies. The moment
+    # any replica declares a non-"mixed" serving.role, steer_prefill=on
+    # is rejected with a typed error naming the migration — the two
+    # mechanisms must not fight over placement (docs/routing.md).
     steer_prefill: str = "off"  # off | on
     steer_prefill_min_tokens: int = 1024
+    # Prefill/decode disaggregation (serving.role, docs/routing.md).
+    # "auto" (default): the two-leg prefill→TransferKV→decode placement
+    # engages as soon as the ServingStats snapshot shows a prefill-role
+    # replica AND a decode-capable one — a pure-mixed fleet routes
+    # exactly as before, bit-for-bit. "off": never split, even with
+    # roles declared (prefill replicas are then simply excluded from
+    # short-request placement).
+    disagg: str = "auto"  # auto | off
+    # Requests whose estimated prefill work (prompt bytes; exact for
+    # the byte tokenizer, ~4x high for BPE) is below this never take
+    # the two-leg path — a short prompt's prefill costs less than the
+    # transfer round-trip it would save.
+    disagg_min_prompt_tokens: int = 1024
     # ServingStats snapshots older than this are considered wedged:
     # score-based policies fall back to round-robin (with a warning)
     # until the background refresh recovers.
@@ -582,6 +607,15 @@ class GatewayConfig:
 class ServingConfig:
     model: str = "tiny-llama"  # registry key in ggrmcp_tpu.models
     dtype: str = "bfloat16"
+    # Replica role in a disaggregated fleet (SERVING_ROLES,
+    # docs/routing.md): "mixed" (default — serve everything, today's
+    # behavior bit-for-bit), "prefill" (take long-prompt admissions,
+    # ship the finished prompt's KV pages to a decode replica via the
+    # sidecar→sidecar TransferKV RPC), or "decode" (admit transferred
+    # requests with pre-populated pages and skip prefill). Non-mixed
+    # roles require batching.paged_kv=on (pages ARE the transfer
+    # format) and no kv_tiers (one arena per replica to import into).
+    role: str = "mixed"
     mesh: MeshConfig = field(default_factory=MeshConfig)
     batching: BatchingConfig = field(default_factory=BatchingConfig)
     port: int = 50051
@@ -898,6 +932,42 @@ class Config:
             raise ValueError(
                 "gateway.routing.stale_stats_max_age_s must be > 0"
             )
+        if routing.disagg not in ("auto", "off"):
+            raise ValueError(
+                f"unknown gateway.routing.disagg {routing.disagg!r}; "
+                "supported: 'auto', 'off'"
+            )
+        if routing.disagg_min_prompt_tokens < 1:
+            raise ValueError(
+                "gateway.routing.disagg_min_prompt_tokens must be >= 1"
+            )
+        if self.serving.role not in SERVING_ROLES:
+            raise ValueError(
+                f"unknown serving.role {self.serving.role!r}; "
+                f"supported: {', '.join(SERVING_ROLES)}"
+            )
+        if self.serving.role != "mixed":
+            if routing.steer_prefill == "on":
+                raise ValueError(
+                    "gateway.routing.steer_prefill=on is superseded by "
+                    "replica roles: a non-'mixed' serving.role does the "
+                    "real prefill/decode split (page-granular KV "
+                    "shipping). Migrate to serving.role + "
+                    "gateway.routing.disagg and drop steer_prefill "
+                    "(docs/routing.md role-split runbook)"
+                )
+            if self.serving.batching.paged_kv != "on":
+                raise ValueError(
+                    f"serving.role={self.serving.role!r} requires "
+                    "batching.paged_kv=on: KV pages are the transfer "
+                    "format (docs/paged_kv.md 'pages over the wire')"
+                )
+            if self.serving.batching.kv_tiers:
+                raise ValueError(
+                    f"serving.role={self.serving.role!r} does not "
+                    "compose with batching.kv_tiers: page import needs "
+                    "ONE arena per replica to land transferred pages in"
+                )
         if self.serving.speculative_gamma < 1:
             raise ValueError("speculative_gamma must be >= 1")
         if self.serving.batching.speculative not in ("off", "on"):

@@ -122,6 +122,21 @@ _SERVING_HELP = {
         "cumulative tick time in device wait + transfer (ms)",
     "tick_phase_host_ms":
         "cumulative tick time in emission/finish bookkeeping (ms)",
+    # Disaggregated prefill/decode serving (serving.role): the
+    # sidecar→sidecar KV page-shipping plane. The role itself is a
+    # string field and exports info-style beside mesh_shape.
+    "kv_transfers_sent":
+        "completed outbound KV page transfers (prefill role)",
+    "kv_transfers_received":
+        "completed inbound KV page transfers (decode role)",
+    "kv_transfer_failures":
+        "outbound KV transfers failed typed (each one a gateway retry "
+        "on a mixed replica)",
+    "kv_transfer_pages_sent": "KV pages shipped to peer sidecars",
+    "kv_transfer_pages_received":
+        "KV pages imported from peer sidecars",
+    "kv_transfer_bytes_sent": "KV transfer wire bytes sent",
+    "kv_transfer_bytes_received": "KV transfer wire bytes received",
 }
 
 _SERVING_HIST_HELP = {
@@ -151,6 +166,15 @@ _ROUTING_HELP = {
         "(score > gateway.routing.spill_threshold)",
     "drain_rejects":
         "placements routed AWAY from this backend while it was draining",
+    "disagg_prefills":
+        "disaggregated prefill legs placed on this (prefill-role) "
+        "backend",
+    "disagg_decodes":
+        "disaggregated decode legs placed on this backend (pages "
+        "arrived via TransferKV; prefill skipped)",
+    "disagg_fallbacks":
+        "whole-request retries placed on this backend after a typed "
+        "KV-transfer failure",
 }
 
 # Per-phase histogram bases render as ONE family with a `phase` label

@@ -20,6 +20,9 @@ import logging
 import time
 from typing import Any, AsyncIterator, Optional
 
+import grpc
+import grpc.aio
+
 from ggrmcp_tpu.core.config import GRPCConfig, RoutingConfig
 from ggrmcp_tpu.core.types import MethodInfo
 from ggrmcp_tpu.rpc.connection import ChannelManager
@@ -61,6 +64,13 @@ class Backend:
         # its tools resolvable via the remaining replicas, un-drain
         # restores it to the candidate set.
         self.draining = False
+        # Declared serving role ("mixed" | "prefill" | "decode"),
+        # stamped by discover_services from the backend's ServingStats
+        # — static per replica process, refreshed on rediscovery (a
+        # role flip is drain → restart → rediscover). The router reads
+        # this attribute on the hot path; plain gRPC upstreams and
+        # pre-role sidecars stay "mixed".
+        self.role = "mixed"
         self.last_discovery: float = 0.0
 
     async def connect(self, timeout_s: Optional[float] = None) -> None:
@@ -239,7 +249,43 @@ class ServiceDiscoverer:
 
         self._tools = registry  # atomic swap
         logger.info("tool registry: %d tools", len(registry))
+        await self._refresh_roles()
         return len(registry)
+
+    async def _refresh_roles(self) -> None:
+        """Stamp each backend's declared serving role (serving.role,
+        via its ServingStats RPC) — once per discovery pass, never on
+        the call path. A backend without the RPC, or whose stats call
+        fails, stays/reverts to "mixed": degrading a prefill replica to
+        mixed serves it ordinary traffic (safe — every replica can),
+        whereas acting on a stale role could starve it."""
+        for backend in self.backends:
+            mi = next(
+                (
+                    m for m in backend.methods
+                    if m.full_name == self.SERVING_STATS_METHOD
+                ),
+                None,
+            )
+            if mi is None or backend.invoker is None:
+                backend.role = "mixed"
+                continue
+            try:
+                out = await backend.invoker.invoke(mi, {}, None, 2.0)
+                role = out.get("role") or "mixed"
+            except asyncio.CancelledError:
+                raise  # a cancelled rebuild must not half-stamp
+            except Exception as exc:  # noqa: BLE001 — degrade to mixed
+                logger.warning(
+                    "role probe failed for %s (treating as mixed): %s",
+                    backend.target, exc,
+                )
+                role = "mixed"
+            if role != backend.role:
+                logger.info(
+                    "backend %s serving role: %s", backend.target, role
+                )
+            backend.role = role
 
     def _tool_allowed(self, mi: MethodInfo) -> bool:
         """Streaming gating applied uniformly to reflection- and
@@ -352,20 +398,15 @@ class ServiceDiscoverer:
 
     # -- invocation ---------------------------------------------------------
 
-    def _route(
-        self,
-        tool_name: str,
-        arguments: Optional[dict[str, Any]] = None,
-        headers: Optional[list[tuple[str, str]]] = None,
-    ) -> tuple[MethodInfo, Backend]:
-        """Pick the serving replica (per-shard routing from the north
-        star; DP replicas share a tool name). Membership filtering
-        happens HERE, at pick time: unhealthy backends are skipped (a
-        dead replica must not keep eating every k-th call until
-        rediscovery), draining backends take no new placements, and the
-        router (gateway.routing.policy) places over what remains —
+    def _candidates(
+        self, tool_name: str
+    ) -> tuple[MethodInfo, list[Backend]]:
+        """Pick-time membership filtering: unhealthy backends are
+        skipped (a dead replica must not keep eating every k-th call
+        until rediscovery), draining backends take no new placements —
         falling back to any connected non-draining backend only when
-        none is healthy."""
+        none is healthy. Shared by single-leg routing and the
+        disaggregated two-leg plan."""
         entry = self._tools.get(tool_name)
         if entry is None:
             raise ToolNotFoundError(f"tool not found: {tool_name}")
@@ -384,7 +425,19 @@ class ServiceDiscoverer:
         for b in live:
             if b.draining:
                 self.router.note_drain_reject(b.target)
-        candidates = [b for b in placeable if b.healthy] or placeable
+        return method, ([b for b in placeable if b.healthy] or placeable)
+
+    def _route(
+        self,
+        tool_name: str,
+        arguments: Optional[dict[str, Any]] = None,
+        headers: Optional[list[tuple[str, str]]] = None,
+    ) -> tuple[MethodInfo, Backend]:
+        """Pick the serving replica (per-shard routing from the north
+        star; DP replicas share a tool name). The router
+        (gateway.routing.policy) places over the filtered candidates
+        (_candidates)."""
+        method, candidates = self._candidates(tool_name)
         affinity_key = None
         if self.router.wants_affinity_key and arguments is not None:
             affinity_key = derive_affinity_key(
@@ -419,6 +472,114 @@ class ServiceDiscoverer:
                 f"backend {backend.target} went down (injected): {exc}"
             ) from exc
 
+    # -- disaggregated prefill/decode placement (serving.role) --------------
+
+    # Only the TPU generate surface is disaggregation-eligible: the
+    # two-leg plan injects GenerateRequest.kv_transfer_target, which no
+    # other discovered method carries.
+    GENERATE_SERVICE_PREFIX = "ggrmcp.tpu.GenerateService."
+
+    def _plan_disagg(
+        self,
+        tool_name: str,
+        arguments: Optional[dict[str, Any]],
+        headers: Optional[list[tuple[str, str]]],
+    ) -> Optional[tuple[MethodInfo, Backend, Backend]]:
+        """(method, prefill replica, decode replica) when this call
+        should take the two-leg prefill→TransferKV→decode path, else
+        None. Cheap on the common paths by construction: pure-mixed
+        fleets bail on the role-attribute scan and non-generate tools
+        on the name prefix — a roleless deployment never pays for a
+        prefill estimate or a plan (and routes bit-for-bit as
+        before)."""
+        if self.router.cfg.disagg == "off" or not isinstance(
+            arguments, dict
+        ):
+            return None
+        if all(b.role == "mixed" for b in self.backends):
+            return None
+        entry = self._tools.get(tool_name)
+        if entry is None or not entry[0].full_name.startswith(
+            self.GENERATE_SERVICE_PREFIX
+        ):
+            return None
+        if arguments.get("adapter"):
+            # Adapter'd KV never enters shared page storage (the LoRA
+            # contamination rule), so there is nothing to ship.
+            return None
+        method, candidates = self._candidates(tool_name)
+        if len(candidates) < 2:
+            return None
+        affinity_key = None
+        if self.router.wants_affinity_key:
+            affinity_key = derive_affinity_key(
+                tool_name, arguments, headers,
+                self.router.cfg.affinity_preamble_bytes,
+            )
+        plan = self.router.plan_disagg(
+            tool_name, candidates,
+            estimate_prefill_tokens(arguments),
+            affinity_key=affinity_key,
+        )
+        if plan is None:
+            return None
+        return method, plan[0], plan[1]
+
+    async def _prefill_leg(
+        self,
+        method: MethodInfo,
+        prefill: Backend,
+        decode: Backend,
+        arguments: dict[str, Any],
+        headers: Optional[list[tuple[str, str]]],
+        timeout: float,
+    ) -> bool:
+        """Run the prefill leg: the same request with
+        kvTransferTarget=<decode replica> — the prefill sidecar
+        prefills, ships the prompt's KV pages to the decode sidecar,
+        and answers "transferred". Returns False on a TYPED transfer
+        failure (gRPC ABORTED / FAILED_PRECONDITION /
+        RESOURCE_EXHAUSTED, or the backend dying under the call): the
+        caller then retries the WHOLE request on a mixed replica —
+        loud, counted, bit-identical. Anything untyped propagates."""
+        prefill_args = dict(arguments)
+        prefill_args["kvTransferTarget"] = decode.target
+        try:
+            self._check_backend_down(prefill)
+            if method.is_server_streaming:
+                async for _chunk in prefill.invoker.invoke_stream(
+                    method, prefill_args, headers, timeout
+                ):
+                    pass  # exactly one terminal "transferred" chunk
+            else:
+                await prefill.invoker.invoke(
+                    method, prefill_args, headers, timeout
+                )
+            return True
+        except asyncio.CancelledError:
+            raise  # the caller is gone; no fallback owed
+        except ConnectionError as exc:
+            # backend_down chaos / dead channel: the prefill replica
+            # died under the leg — same typed retry as a failed ship.
+            logger.warning(
+                "disagg prefill leg on %s failed (%s); retrying on a "
+                "mixed replica", prefill.target, exc,
+            )
+            return False
+        except grpc.aio.AioRpcError as exc:
+            if exc.code() in (
+                grpc.StatusCode.ABORTED,
+                grpc.StatusCode.FAILED_PRECONDITION,
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+            ):
+                logger.warning(
+                    "disagg prefill leg on %s failed typed (%s: %s); "
+                    "retrying on a mixed replica",
+                    prefill.target, exc.code().name, exc.details(),
+                )
+                return False
+            raise
+
     async def invoke_by_tool(
         self,
         tool_name: str,
@@ -426,14 +587,38 @@ class ServiceDiscoverer:
         headers: Optional[list[tuple[str, str]]] = None,
         timeout_s: Optional[float] = None,
     ) -> dict[str, Any]:
-        """Route a unary tool call (discovery.go:346-375 parity)."""
+        """Route a unary tool call (discovery.go:346-375 parity).
+        Long-prompt calls in a role-split fleet take the two-leg
+        disaggregated path (_plan_disagg); everything else routes as
+        before."""
+        timeout = timeout_s if timeout_s is not None else self.cfg.call_timeout_s
+        plan = self._plan_disagg(tool_name, arguments, headers)
+        if plan is not None:
+            method, prefill, decode = plan
+            if method.is_streaming:
+                raise StreamingNotSupportedError(
+                    f"tool {tool_name} is streaming; use "
+                    f"invoke_stream_by_tool"
+                )
+            if await self._prefill_leg(
+                method, prefill, decode, arguments, headers, timeout
+            ):
+                self._check_backend_down(decode)
+                return await decode.invoker.invoke(
+                    method, arguments, headers, timeout
+                )
+            _, candidates = self._candidates(tool_name)
+            backend = self.router.pick_fallback(tool_name, candidates)
+            self._check_backend_down(backend)
+            return await backend.invoker.invoke(
+                method, arguments, headers, timeout
+            )
         method, backend = self._route(tool_name, arguments, headers)
         if method.is_streaming:
             raise StreamingNotSupportedError(
                 f"tool {tool_name} is streaming; use invoke_stream_by_tool"
             )
         self._check_backend_down(backend)
-        timeout = timeout_s if timeout_s is not None else self.cfg.call_timeout_s
         return await backend.invoker.invoke(method, arguments, headers, timeout)
 
     async def invoke_stream_by_tool(
@@ -443,12 +628,32 @@ class ServiceDiscoverer:
         headers: Optional[list[tuple[str, str]]] = None,
         timeout_s: Optional[float] = None,
     ) -> AsyncIterator[dict[str, Any]]:
-        """Route a server-streaming tool call (no reference analogue)."""
-        method, backend = self._route(tool_name, arguments, headers)
-        if method.is_client_streaming:
-            raise StreamingNotSupportedError("client streaming not supported")
-        self._check_backend_down(backend)
+        """Route a server-streaming tool call (no reference analogue).
+        Disaggregation applies here too: the prefill leg is consumed
+        silently (one "transferred" chunk), then the decode replica's
+        stream is the caller's stream."""
         timeout = timeout_s if timeout_s is not None else self.cfg.call_timeout_s
+        plan = self._plan_disagg(tool_name, arguments, headers)
+        if plan is not None:
+            method, prefill, decode = plan
+            if method.is_client_streaming:
+                raise StreamingNotSupportedError(
+                    "client streaming not supported"
+                )
+            if await self._prefill_leg(
+                method, prefill, decode, arguments, headers, timeout
+            ):
+                backend = decode
+            else:
+                _, candidates = self._candidates(tool_name)
+                backend = self.router.pick_fallback(tool_name, candidates)
+        else:
+            method, backend = self._route(tool_name, arguments, headers)
+            if method.is_client_streaming:
+                raise StreamingNotSupportedError(
+                    "client streaming not supported"
+                )
+        self._check_backend_down(backend)
         if not method.is_server_streaming:
             yield await backend.invoker.invoke(method, arguments, headers, timeout)
             return
@@ -482,6 +687,7 @@ class ServiceDiscoverer:
                 "target": b.target,
                 "healthy": b.healthy,
                 "draining": b.draining,
+                "role": b.role,
             }
             for b in self.backends
         ]
@@ -664,6 +870,7 @@ class ServiceDiscoverer:
                     "target": b.target,
                     "healthy": b.healthy,
                     "draining": b.draining,
+                    "role": b.role,
                     "methodCount": len(b.methods),
                 }
                 for b in self.backends

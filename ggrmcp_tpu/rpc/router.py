@@ -32,12 +32,24 @@ Three policies:
   ``spill_threshold``, the call spills to the least-loaded replica and
   the spill is counted.
 
-Experimental prefill steering (``steer_prefill=on``): requests whose
-estimated prefill work exceeds a threshold prefer replicas whose
-cumulative tick-phase attribution (PR 9's phase scalars) shows the
-smallest admit-phase share — a cheap, signal-driven approximation of
-DistServe-style prefill/decode disaggregation. Only consulted when no
-affinity key applies; cache locality outranks steering.
+Disaggregated prefill/decode fleets (serving.role, docs/routing.md):
+replicas declare a role through ServingStats; the discoverer stamps it
+onto each Backend at discovery time (roles are static per replica
+process), so the hot path reads an attribute, never a snapshot.
+Prefill-role replicas are excluded from
+ordinary placement (_role_filtered); long-prompt requests take a
+two-leg plan (plan_disagg) — prefill leg on a prefill replica (which
+ships the prompt's KV pages to the decode replica via the sidecar
+TransferKV RPC), decode leg through the ordinary pick() so affinity
+keeps protecting the decode replica's page index. A failed transfer
+retries typed on a mixed replica (pick_fallback, counted). A
+pure-mixed fleet takes none of these branches and routes bit-for-bit
+like the pre-role gateway.
+
+Deprecated prefill steering (``steer_prefill=on``): the pre-role
+heuristic that preferred replicas with the smallest admit-phase share.
+Rejected typed (RoleConfigError) the moment any replica declares a
+non-mixed role — the heuristic and the real split must not fight.
 
 Observability: per-backend counters (routing_picks, affinity_hits,
 affinity_spills, drain_rejects) exported as gateway_routing_* metrics
@@ -70,7 +82,27 @@ EWMA_ALPHA = 0.3
 # suffixes — gateway/metrics.py renders help from _ROUTING_HELP).
 COUNTER_NAMES = (
     "routing_picks", "affinity_hits", "affinity_spills", "drain_rejects",
+    "disagg_prefills", "disagg_decodes", "disagg_fallbacks",
 )
+
+
+class RoleConfigError(ValueError):
+    """steer_prefill=on met a fleet with declared replica roles. The
+    heuristic and the real split must not fight over placement, so the
+    combination is rejected typed, naming the migration — at config
+    validation when both live in one tree, and here at pick time when
+    the roles arrive over the wire from independently configured
+    replicas."""
+
+    def __init__(self) -> None:
+        super().__init__(
+            "gateway.routing.steer_prefill=on is superseded by replica "
+            "roles: this fleet declares non-'mixed' serving.role "
+            "replicas, which do the real prefill/decode split "
+            "(page-granular KV shipping). Drop steer_prefill and use "
+            "gateway.routing.disagg (docs/routing.md role-split "
+            "runbook)"
+        )
 
 
 def derive_affinity_key(
@@ -147,6 +179,8 @@ class ReplicaRouter:
         # Loud-degrade latch: warn once per staleness episode, not once
         # per call (a wedged refresh would otherwise flood the log).
         self._stale_warned = False
+        # Same latch for the all-prefill-pool degenerate fleet.
+        self._all_prefill_warned = False
 
     # -- properties the hot path gates on --------------------------------
 
@@ -293,6 +327,115 @@ class ReplicaRouter:
         ]
         return light or None
 
+    # -- replica roles (disaggregated prefill/decode fleets) ---------------
+    #
+    # Roles are STATIC per replica process (serving.role config): the
+    # discoverer reads each backend's role once at discovery time (one
+    # GetServingStats on the cold path) and stamps it on the Backend —
+    # so the hot path reads an attribute, never a snapshot, and a
+    # pure-mixed fleet routes bit-for-bit like the pre-role gateway. A
+    # role change is a drain → restart → rediscover cycle
+    # (docs/routing.md role-flip runbook), exactly like a method-set
+    # change.
+
+    @staticmethod
+    def _role_of(backend: Any) -> str:
+        return getattr(backend, "role", "mixed") or "mixed"
+
+    def _role_filtered(self, candidates: Sequence[Any]) -> Sequence[Any]:
+        """Exclude prefill-role replicas from ordinary (single-leg)
+        placement: a dedicated prefill replica serves prefill legs, not
+        decode traffic — that isolation is the whole point of the
+        split. No-op on a pure-mixed fleet. An all-prefill candidate
+        set degrades loudly to the full set: serving wrong-role traffic
+        beats serving nothing."""
+        if all(self._role_of(b) == "mixed" for b in candidates):
+            return candidates
+        if self.cfg.steer_prefill == "on":
+            raise RoleConfigError()
+        serving = [
+            b for b in candidates if self._role_of(b) != "prefill"
+        ]
+        if not serving:
+            if not self._all_prefill_warned:
+                logger.warning(
+                    "routing: every placeable replica declares "
+                    "role=prefill; placing decode traffic on them "
+                    "anyway (add decode or mixed replicas)"
+                )
+                self._all_prefill_warned = True
+            return candidates
+        self._all_prefill_warned = False
+        return serving
+
+    def plan_disagg(
+        self,
+        tool_name: str,
+        candidates: Sequence[Any],
+        est_prefill_tokens: int,
+        affinity_key: Optional[bytes] = None,
+    ) -> Optional[tuple[Any, Any]]:
+        """(prefill replica, decode replica) for a long-prompt request
+        in a role-split fleet, or None to take the ordinary
+        single-replica path. The prefill leg places least-loaded over
+        the prefill-role replicas; the decode leg is the ordinary
+        pick() over the decode-capable ones, so session/prefix affinity
+        keeps protecting the decode replica's page index."""
+        if (
+            self.cfg.disagg == "off"
+            or len(candidates) < 2
+            or est_prefill_tokens < self.cfg.disagg_min_prompt_tokens
+        ):
+            return None
+        roles = {b.target: self._role_of(b) for b in candidates}
+        if self.cfg.steer_prefill == "on" and any(
+            r != "mixed" for r in roles.values()
+        ):
+            raise RoleConfigError()
+        prefills = [
+            b for b in candidates if roles[b.target] == "prefill"
+        ]
+        # Dedicated decode replicas take the decode leg; mixed replicas
+        # only when none exist (they are the fallback pool — keeping
+        # them out of the steady-state leg keeps their arenas free for
+        # retries and short traffic).
+        decodes = [
+            b for b in candidates if roles[b.target] == "decode"
+        ] or [
+            b for b in candidates if roles[b.target] != "prefill"
+        ]
+        if not prefills or not decodes:
+            return None
+        prefill = self._pick_least_loaded(
+            tool_name + "\x00prefill", prefills
+        )
+        decode = self.pick(
+            tool_name, decodes, affinity_key=affinity_key
+        )
+        self._counter(prefill.target)["routing_picks"] += 1
+        self._counter(prefill.target)["disagg_prefills"] += 1
+        self._counter(decode.target)["disagg_decodes"] += 1
+        return prefill, decode
+
+    def pick_fallback(
+        self, tool_name: str, candidates: Sequence[Any]
+    ) -> Any:
+        """The typed retry target after a failed prefill leg or KV
+        transfer: a mixed replica when one exists (it can run the whole
+        request), else any decode-capable one, else anything — the
+        request must finish correctly somewhere, and the fallback is
+        counted, never silent."""
+        mixed = [
+            b for b in candidates if self._role_of(b) == "mixed"
+        ]
+        pool = mixed or [
+            b for b in candidates if self._role_of(b) != "prefill"
+        ] or list(candidates)
+        chosen = self._pick_least_loaded(tool_name, pool)
+        self._counter(chosen.target)["routing_picks"] += 1
+        self._counter(chosen.target)["disagg_fallbacks"] += 1
+        return chosen
+
     # -- placement --------------------------------------------------------
 
     def pick(
@@ -305,7 +448,10 @@ class ReplicaRouter:
         """Choose the serving replica among `candidates` (non-empty,
         already filtered to connected + healthy-or-last-resort +
         non-draining by the discoverer). Objects only need a `.target`
-        attribute."""
+        attribute. Prefill-role replicas are additionally excluded here
+        (_role_filtered) — they serve prefill legs, placed by
+        plan_disagg, not ordinary traffic."""
+        candidates = self._role_filtered(candidates)
         policy = self.cfg.policy
         chosen = None
         if policy == "affinity" and affinity_key is not None:

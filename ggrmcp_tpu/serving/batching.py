@@ -49,6 +49,14 @@ from ggrmcp_tpu.utils.stats import pct
 logger = logging.getLogger("ggrmcp.serving.batching")
 
 
+class KVTransferError(RuntimeError):
+    """A KV page export/import that cannot proceed (paging off, no
+    indexed pages, geometry/dtype mismatch). Typed so the TransferKV
+    plane degrades loudly: the sidecar maps it to a non-OK status and
+    the gateway retries the request on a mixed replica — never a
+    silent recompute dressed up as a successful transfer."""
+
+
 class OverloadedError(RuntimeError):
     """submit() refused a request because the admission queue is at its
     configured cap (batching.max_pending / max_queue_tokens). The
@@ -289,6 +297,12 @@ class ContinuousBatcher:
         # In-flight dispatched-not-yet-collected ticks, oldest first:
         # (tokens [B, steps] device array, per-slot owner snapshot).
         self._inflight: deque = deque()
+        # Serialized host-op queue (run_host_op): (fn, future) pairs the
+        # loop drains between ticks in its ONE executor stream — the
+        # entry point for work that must not interleave with admissions
+        # or ticks (KV page export/import for the TransferKV plane,
+        # docs/paged_kv.md). Futures resolve on the loop.
+        self._host_ops: deque = deque()
         # Ring-buffer serving (engine.ring_capacity, sliding-window
         # models): the cache holds window + prefill_chunk - 1 positions
         # and request length is bounded by the RoPE range, not the
@@ -708,6 +722,118 @@ class ContinuousBatcher:
         return llama_mod.PagedKVCache(
             k=k, v=v, table=cache.table, length=length
         )
+
+    # -- KV page export/import (sidecar→sidecar TransferKV plane) -----------
+
+    def clamp_prompt(
+        self, prompt: list[int], max_new: int
+    ) -> list[int]:
+        """The prompt exactly as an admission for (prompt, max_new)
+        will see it (fit_request keeps the TAIL, sized by max_new and
+        the tick-overshoot reserve). The disaggregated prefill leg must
+        admit and export THIS prompt — with the request's real max_new,
+        not its own 1-token one — or a near-limit prompt would register
+        a different chain than the decode replica's identically clamped
+        admission looks up."""
+        clamped, _ = fit_request(
+            prompt, max_new, self._fit_limit - self._reserve
+        )
+        return clamped
+
+    def export_prompt_kv(self, prompt: list[int]) -> dict:
+        """Gather the indexed full-page KV of `prompt` from the device
+        arena to host (the prefill-role half of disaggregated serving;
+        run via run_host_op — the serialized executor stream is what
+        makes the lookup + gather atomic against eviction). Returns
+        {pages, page_size, k, v[, k_scale, v_scale]} with [L, n, P,
+        KVH, Dh] host arrays (int8 KV ships values + scales — half the
+        bytes). Raises KVTransferError when paging is off or the index
+        holds no pages for this prompt (evicted, or never admitted):
+        the caller degrades typed, never ships a lie."""
+        if not self._paged:
+            raise KVTransferError(
+                "kv export requires batching.paged_kv=on"
+            )
+        pages = self.pages.chain_pages(prompt)
+        if not pages:
+            raise KVTransferError(
+                "no indexed pages for this prompt (evicted before "
+                "export, or the prompt is shorter than one page)"
+            )
+        idx = np.asarray(pages, np.int32)
+        out: dict = {"pages": len(pages), "page_size": self._page_size}
+        for name, leaf in (("k", self.cache.k), ("v", self.cache.v)):
+            if isinstance(leaf, quant.QuantizedArray):
+                out[name] = np.asarray(leaf.q[:, idx])
+                out[name + "_scale"] = np.asarray(leaf.scale[:, idx])
+            else:
+                out[name] = np.asarray(leaf[:, idx])
+        return out
+
+    def import_prompt_kv(
+        self,
+        prompt: list[int],
+        start_page: int,
+        k: np.ndarray,
+        v: np.ndarray,
+        k_scale: "Optional[np.ndarray]" = None,
+        v_scale: "Optional[np.ndarray]" = None,
+    ) -> tuple[int, int]:
+        """Land one TransferKV chunk in this batcher's arena (the
+        decode-role half; run via run_host_op): allocate + index the
+        chunk's pages host-side (pages.import_chain — refcount 0,
+        LRU-stamped, evictable) and write their contents into the
+        device arena. Returns (pages_imported, pages_already_present).
+        The device write dispatches INSIDE the serialized stream, so
+        any later admission's gather reads it by device ordering — the
+        same soundness argument as eager same-round registration.
+        Raises KVTransferError on geometry/dtype mismatch and
+        PageExhaustedError when the arena can't host the chunk."""
+        if not self._paged:
+            raise KVTransferError(
+                "kv import requires batching.paged_kv=on"
+            )
+        arena_k = self.cache.k
+        quantized = isinstance(arena_k, quant.QuantizedArray)
+        if quantized != (k_scale is not None):
+            raise KVTransferError(
+                "kv dtype mismatch: sender and receiver must both use "
+                "int8 KV or neither (serving.kv_cache_dtype)"
+            )
+        ref = arena_k.q if quantized else arena_k
+        want = (ref.shape[0],) + ref.shape[2:]  # [L, P, KVH, Dh]
+        got = (k.shape[0],) + k.shape[2:]
+        if got != want or v.shape != k.shape:
+            raise KVTransferError(
+                f"kv page geometry mismatch: got {got}, arena wants "
+                f"{want} (layers, page_size, kv_heads, head_dim)"
+            )
+        placed = self.pages.import_chain(
+            prompt, start_page, int(k.shape[1])
+        )
+        present = int(k.shape[1]) - len(placed)
+        if not placed:
+            return 0, present
+        dst = np.asarray([p for _, p in placed], np.int32)
+        src = np.asarray([j - start_page for j, _ in placed], np.int32)
+
+        def put(a, m):
+            return a.at[:, dst].set(self._snap_dev(m).astype(a.dtype))
+
+        if quantized:
+            new_k = quant.QuantizedArray(
+                q=put(arena_k.q, k[:, src]),
+                scale=put(arena_k.scale, k_scale[:, src]),
+            )
+            new_v = quant.QuantizedArray(
+                q=put(self.cache.v.q, v[:, src]),
+                scale=put(self.cache.v.scale, v_scale[:, src]),
+            )
+        else:
+            new_k = put(arena_k, k[:, src])
+            new_v = put(self.cache.v, v[:, src])
+        self.cache = self.cache._replace(k=new_k, v=new_v)
+        return len(placed), present
 
     # -- grammar host side (serving/batching owns residency + states) -------
 
@@ -1853,6 +1979,46 @@ class ContinuousBatcher:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        # Fail queued host ops LOUDLY: a TransferKV handler awaiting an
+        # import must get an error, not hang on a future the dead loop
+        # will never resolve.
+        while self._host_ops:
+            _, fut = self._host_ops.popleft()
+            if not fut.done():
+                fut.set_exception(RuntimeError("batcher stopped"))
+
+    async def run_host_op(self, fn):
+        """Run `fn()` (host + device work) in the batcher's serialized
+        executor stream — between ticks and admission rounds, never
+        concurrent with them. The entry point for externally triggered
+        arena work (KV page export/import); returns fn's result or
+        re-raises its exception. The batcher loop must be running."""
+        if self._task is None or self._stopping:
+            raise RuntimeError("batcher is not running")
+        fut = asyncio.get_running_loop().create_future()
+        self._host_ops.append((fn, fut))
+        self._wake.set()
+        return await fut
+
+    async def _drain_host_ops(self, loop) -> None:
+        """Execute queued host ops in FIFO order, one executor call
+        each (same serialization contract as ticks/admissions). Op
+        failures resolve the caller's future and never kill the loop —
+        a bad import is the transfer's problem, not the pool's."""
+        while self._host_ops:
+            fn, fut = self._host_ops.popleft()
+            try:
+                result = await loop.run_in_executor(None, fn)
+            except asyncio.CancelledError:
+                if not fut.done():
+                    fut.set_exception(RuntimeError("batcher stopped"))
+                raise  # batcher shutdown cancels the loop task
+            except Exception as exc:  # noqa: BLE001 — delivered, not dropped
+                if not fut.done():
+                    fut.set_exception(exc)
+            else:
+                if not fut.done():
+                    fut.set_result(result)
 
     def submit(
         self,
@@ -2147,6 +2313,7 @@ class ContinuousBatcher:
     async def _loop(self) -> None:
         loop = asyncio.get_running_loop()
         while not self._stopping:
+            await self._drain_host_ops(loop)
             admitted = await self._admit()
             if self._active_count() == 0 and not self._ilv_busy():
                 if self._inflight:
@@ -2169,7 +2336,7 @@ class ContinuousBatcher:
                 # the check still leaves its set() visible to wait(),
                 # avoiding the lost-wakeup race.
                 self._wake.clear()
-                if not self.pending.empty():
+                if not self.pending.empty() or self._host_ops:
                     continue
                 await self._wake.wait()
                 continue

@@ -271,6 +271,86 @@ class PageAllocator:
             pages_shared=m,
         )
 
+    def chain_pages(self, prompt: list) -> list[int]:
+        """The indexed arena pages holding `prompt`'s full pages,
+        walking the hash chain from the root — the export set a
+        prefill-role replica ships over TransferKV (docs/paged_kv.md
+        "pages over the wire"). Content-verified like _lookup; stops at
+        the first un-indexed (or evicted) page, so the result is always
+        a valid page-aligned prefix. Read-only: refcounts, stamps, and
+        the index are untouched — handoff safety comes from the caller
+        running inside the batcher's serialized executor stream, where
+        no eviction can interleave with the device gather."""
+        p = self.page_size
+        arr = np.asarray(prompt, np.int32)
+        key = _ROOT
+        pages: list[int] = []
+        for j in range(len(arr) // p):
+            toks = arr[j * p:(j + 1) * p]
+            nxt = self._chain(key, toks)
+            page = self._index.get(nxt)
+            if page is None or not np.array_equal(
+                self._tokens_of[page], toks
+            ):
+                break
+            pages.append(page)
+            key = nxt
+        return pages
+
+    def import_chain(
+        self, prompt: list, start_page: int, count: int
+    ) -> list[tuple[int, int]]:
+        """Register externally computed KV pages (a TransferKV chunk)
+        for `prompt`'s full pages [start_page, start_page + count).
+        Returns [(prompt_page_j, arena_page)] for the pages actually
+        allocated — the caller writes those pages' contents into the
+        device arena at the returned indices. Pages whose chain key is
+        already indexed are skipped (dedup — the resident copy was
+        verified at registration; a colliding-but-different entry keeps
+        precedence exactly like register()).
+
+        Refcount handoff rule: imported pages enter at refcount 0,
+        LRU-stamped — evictable cache, indistinguishable from a
+        finished local request's indexed pages. The re-issued request's
+        admission refcounts them through the ordinary prefix-sharing
+        path; until then they may be evicted under pressure, which
+        costs the decode replica a (bit-identical) partial prefill,
+        never correctness. Raises PageExhaustedError when the arena
+        cannot host the chunk (all-or-nothing: nothing registered)."""
+        p = self.page_size
+        arr = np.asarray(prompt, np.int32)
+        full = len(arr) // p
+        if start_page < 0 or count < 1 or start_page + count > full:
+            raise ValueError(
+                f"import range [{start_page}, {start_page + count}) "
+                f"outside the prompt's {full} full pages"
+            )
+        keys: list[int] = []
+        key = _ROOT
+        for j in range(start_page + count):
+            key = self._chain(key, arr[j * p:(j + 1) * p])
+            keys.append(key)
+        todo: list[int] = []
+        for j in range(start_page, start_page + count):
+            if keys[j] in self._index:
+                continue  # resident (or colliding) entry keeps precedence
+            todo.append(j)
+        self._reclaim(len(todo))  # may raise; nothing registered yet
+        placed: list[tuple[int, int]] = []
+        for j in todo:
+            page = self._free.pop()
+            parent = keys[j - 1] if j > 0 else _ROOT
+            self._index[keys[j]] = page
+            self._key_of[page] = keys[j]
+            self._tokens_of[page] = arr[j * p:(j + 1) * p].copy()
+            self._parent_of[page] = parent
+            self._children.setdefault(parent, set()).add(page)
+            self._ref[page] = 0
+            self._clock += 1
+            self._stamp[page] = self._clock
+            placed.append((j, page))
+        return placed
+
     def register(self, slot: int, prompt: list) -> None:
         """Index every full page of a successfully prefilled prompt so
         later admissions can share it. Pages already on the chain

@@ -22,7 +22,7 @@ import grpc
 import grpc.aio
 import numpy as np
 
-from ggrmcp_tpu.core.config import Config, ServingConfig
+from ggrmcp_tpu.core.config import SERVING_ROLES, Config, ServingConfig
 from ggrmcp_tpu.grammar import (
     CompiledGrammar,
     GrammarCache,
@@ -39,10 +39,15 @@ from ggrmcp_tpu.rpc.server_utils import (
     add_service,
 )
 from ggrmcp_tpu.serving import tensors
-from ggrmcp_tpu.serving.batching import ContinuousBatcher, OverloadedError
+from ggrmcp_tpu.serving.batching import (
+    ContinuousBatcher,
+    KVTransferError,
+    OverloadedError,
+)
+from ggrmcp_tpu.serving.pages import PageExhaustedError
 from ggrmcp_tpu.serving.engine import EmbeddingEngine, GenerationEngine
 from ggrmcp_tpu.serving.tokenizer import ByteTokenizer, load_tokenizer
-from ggrmcp_tpu.utils import tracing
+from ggrmcp_tpu.utils import failpoints, tracing
 
 logger = logging.getLogger("ggrmcp.serving.sidecar")
 
@@ -136,6 +141,41 @@ class Sidecar:
         self.port = 0
         self.target = ""  # dialable target string, set by start()
         self._profile_lock = asyncio.Lock()
+        # Disaggregated serving (serving.role, docs/routing.md): the
+        # declared role rides ServingStats so the gateway's role-aware
+        # router can place on it; the kv_transfer_* counters track the
+        # sidecar→sidecar page-shipping plane. Mirrors config.validate
+        # for sidecars built directly in tests: a non-mixed role
+        # without a paged, non-tiered generate batcher can neither
+        # export nor import pages — fail at build, not mid-transfer.
+        role = getattr(self.serving, "role", "mixed")
+        if role not in SERVING_ROLES:
+            raise ValueError(
+                f"unknown serving.role {role!r}; supported: "
+                f"{', '.join(SERVING_ROLES)}"
+            )
+        if role != "mixed" and (
+            not isinstance(self.batcher, ContinuousBatcher)
+            or self.serving.batching.paged_kv != "on"
+        ):
+            raise ValueError(
+                f"serving.role={role!r} requires batching.paged_kv=on "
+                "and no kv_tiers: KV pages are the transfer format "
+                "and page import needs one arena (docs/paged_kv.md)"
+            )
+        self._transfer_stats = dict.fromkeys(
+            (
+                "kv_transfers_sent", "kv_transfers_received",
+                "kv_transfer_failures", "kv_transfer_pages_sent",
+                "kv_transfer_pages_received", "kv_transfer_bytes_sent",
+                "kv_transfer_bytes_received",
+            ),
+            0,
+        )
+        # Peer sidecar channels for outbound TransferKV, keyed by
+        # dialable target — long-lived like the gateway's backend
+        # channels (a transfer per long prompt must not pay a dial).
+        self._peer_channels: dict[str, grpc.aio.Channel] = {}
         # Schema-constrained decoding (ggrmcp_tpu/grammar): LRU of
         # compiled DFAs keyed by canonical schema hash — a tool whose
         # output schema rides every call compiles once (the compiles/
@@ -311,6 +351,13 @@ class Sidecar:
             context.invocation_metadata()
         )
         prompt = self._prompt_ids(request)
+        if request.kv_transfer_target:
+            # Disaggregated prefill leg: prefill only, ship the pages,
+            # return "transferred" — the gateway re-issues the request
+            # to the peer, whose admission skips prefill entirely.
+            return await self._prefill_and_ship(
+                request, context, prompt, trace_id, t0
+            )
         max_new = request.max_new_tokens or 64
         max_new = min(max_new, self.serving.batching.max_decode_steps)
         seed = request.sampling.seed or 0
@@ -422,6 +469,16 @@ class Sidecar:
             context.invocation_metadata()
         )
         prompt = self._prompt_ids(request)
+        if request.kv_transfer_target:
+            # Same disaggregated prefill leg as unary Generate; the
+            # stream carries exactly one terminal "transferred" chunk.
+            await self._prefill_and_ship(
+                request, context, prompt, trace_id, time.perf_counter(),
+            )
+            yield serving_pb2.GenerateChunk(
+                finish_reason="transferred", done=True
+            )
+            return
         max_new = min(
             request.max_new_tokens or 64, self.serving.batching.max_decode_steps
         )
@@ -533,6 +590,225 @@ class Sidecar:
         )
 
     # ------------------------------------------------------------------
+    # KVTransferService — sidecar→sidecar page shipping (serving.role)
+    # ------------------------------------------------------------------
+
+    # Target payload bytes per TransferKV chunk: comfortably under the
+    # default 4 MB gRPC message cap with proto overhead included, while
+    # big enough that a 4k-token llama3-8b prompt ships in a handful of
+    # calls. Long prompts stream as several in-order chunks, each
+    # self-contained (prompt + start_page), so a failed transfer leaves
+    # only a VALID shorter prefix behind — warmth, never corruption.
+    TRANSFER_CHUNK_BYTES = 2 << 20
+
+    def _transfer_call(self, target: str):
+        """Cached unary stub for a peer sidecar's TransferKV."""
+        channel = self._peer_channels.get(target)
+        if channel is None:
+            channel = grpc.aio.insecure_channel(target)
+            self._peer_channels[target] = channel
+        return channel.unary_unary(
+            "/ggrmcp.tpu.KVTransferService/TransferKV",
+            request_serializer=(
+                serving_pb2.KVTransferRequest.SerializeToString
+            ),
+            response_deserializer=(
+                serving_pb2.KVTransferResponse.FromString
+            ),
+        )
+
+    async def _ship_kv(
+        self, target: str, prompt: list[int], export: dict
+    ) -> tuple[int, int]:
+        """Stream one exported prompt's pages to a peer sidecar as
+        in-order TransferKV chunks. Returns (pages, wire bytes); any
+        failure propagates to _prefill_and_ship's typed ABORTED."""
+        n = export["pages"]
+        arrays = [
+            a for a in export.values() if isinstance(a, np.ndarray)
+        ]
+        per_page = max(1, sum(a.nbytes for a in arrays) // n)
+        per_chunk = max(1, self.TRANSFER_CHUNK_BYTES // per_page)
+        call = self._transfer_call(target)
+        quantized = "k_scale" in export
+        sent_bytes = 0
+        for start in range(0, n, per_chunk):
+            end = min(n, start + per_chunk)
+            chunk = serving_pb2.KVTransferRequest(
+                prompt_ids=prompt,
+                page_size=export["page_size"],
+                start_page=start,
+                total_pages=n,
+                k_pages=tensors.to_proto(export["k"][:, start:end]),
+                v_pages=tensors.to_proto(export["v"][:, start:end]),
+                kv_dtype=self.serving.kv_cache_dtype,
+                model_id=self.generation.cfg.name,
+                done=end == n,
+            )
+            if quantized:
+                chunk.k_scales.CopyFrom(
+                    tensors.to_proto(export["k_scale"][:, start:end])
+                )
+                chunk.v_scales.CopyFrom(
+                    tensors.to_proto(export["v_scale"][:, start:end])
+                )
+            sent_bytes += chunk.ByteSize()
+            await call(chunk, timeout=30.0)
+        return n, sent_bytes
+
+    async def _prefill_and_ship(
+        self, request, context, prompt: list[int], trace_id: str,
+        t0: float,
+    ):
+        """The prefill-role leg of a disaggregated call: admit the
+        prompt for ONE token (the admission prefill computes and
+        indexes the prompt's page chain; the sampled token is
+        discarded — the decode replica samples every output token
+        itself, which is what keeps greedy outputs bit-identical to a
+        one-replica run), export the chain, ship it to `target`.
+        Every failure is TYPED — gRPC ABORTED with a "kv transfer
+        failed" detail — so the gateway retries the whole request on a
+        mixed replica; a transfer failure is never silently recomputed
+        into a normal-looking success here."""
+        target = request.kv_transfer_target
+        # Clamp with the REQUEST's max_new (fit_request keeps the
+        # tail): the exported chain must be the one the decode
+        # replica's identically clamped admission will look up.
+        max_new = min(
+            request.max_new_tokens or 64,
+            self.serving.batching.max_decode_steps,
+        )
+        clamp = getattr(self.batcher, "clamp_prompt", None)
+        if clamp is not None:
+            prompt = clamp(prompt, max_new)
+        try:
+            # Chaos hook (utils/failpoints.py kv_transfer_fail): an
+            # injected fault IS a failed transfer — same typed path.
+            failpoints.evaluate("kv_transfer_fail")
+        except failpoints.FailpointError as exc:
+            self._transfer_stats["kv_transfer_failures"] += 1
+            await context.abort(
+                grpc.StatusCode.ABORTED,
+                f"kv transfer failed (injected): {exc}",
+            )
+        finish = "error"
+        try:
+            it = self.batcher.submit(
+                prompt, 1, SamplingConfig(temperature=0.0), 0,
+                unary=True, trace_id=trace_id,
+            )
+        except OverloadedError as exc:
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"server overloaded ({exc.reason}): {exc}",
+            )
+        async for _ids, reason in it:
+            if reason:
+                finish = reason
+        if finish not in ("stop", "length", "grammar_complete"):
+            self._transfer_stats["kv_transfer_failures"] += 1
+            await context.abort(
+                grpc.StatusCode.ABORTED,
+                f"kv transfer failed: prefill finished {finish!r}",
+            )
+        try:
+            export = await self.batcher.run_host_op(
+                lambda: self.batcher.export_prompt_kv(prompt)
+            )
+            pages, wire_bytes = await self._ship_kv(
+                target, prompt, export
+            )
+        except asyncio.CancelledError:
+            raise  # client disconnect must cancel, not "error"
+        except Exception as exc:  # noqa: BLE001 — typed ABORTED below
+            self._transfer_stats["kv_transfer_failures"] += 1
+            logger.warning("kv transfer to %s failed: %s", target, exc)
+            await context.abort(
+                grpc.StatusCode.ABORTED, f"kv transfer failed: {exc}"
+            )
+        self._transfer_stats["kv_transfers_sent"] += 1
+        self._transfer_stats["kv_transfer_pages_sent"] += pages
+        self._transfer_stats["kv_transfer_bytes_sent"] += wire_bytes
+        logger.info(
+            "kv transfer: %d pages (%d bytes) of a %d-token prompt "
+            "shipped to %s", pages, wire_bytes, len(prompt), target,
+        )
+        return serving_pb2.GenerateResponse(
+            finish_reason="transferred",
+            prompt_tokens=len(prompt),
+            model_id=self.generation.cfg.name,
+            compute_ms=(time.perf_counter() - t0) * 1000,
+        )
+
+    async def transfer_kv(
+        self, request: serving_pb2.KVTransferRequest, context
+    ):
+        """Receive one KV-page chunk into this replica's arena. The
+        import is refcount-safe by construction: pages land at
+        refcount 0 in the prefix index (evictable, exactly like a
+        finished local request's pages) and the device write runs in
+        the batcher's serialized executor stream, so no tick or
+        admission can observe a half-written page."""
+        batcher = self.batcher
+        if not isinstance(batcher, ContinuousBatcher) or not getattr(
+            batcher, "_paged", False
+        ):
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "kv import requires a paged, non-tiered batcher "
+                "(batching.paged_kv=on)",
+            )
+        if (request.kv_dtype or "") != (self.serving.kv_cache_dtype or ""):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"kv dtype mismatch: sender {request.kv_dtype!r} vs "
+                f"receiver {self.serving.kv_cache_dtype!r}",
+            )
+        if request.page_size != batcher._page_size:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"page size mismatch: sender {request.page_size} vs "
+                f"receiver {batcher._page_size}",
+            )
+        k = tensors.from_proto(request.k_pages)
+        v = tensors.from_proto(request.v_pages)
+        k_scale = (
+            tensors.from_proto(request.k_scales)
+            if request.HasField("k_scales") else None
+        )
+        v_scale = (
+            tensors.from_proto(request.v_scales)
+            if request.HasField("v_scales") else None
+        )
+        prompt = list(request.prompt_ids)
+        start = int(request.start_page)
+        try:
+            imported, present = await batcher.run_host_op(
+                lambda: batcher.import_prompt_kv(
+                    prompt, start, k, v, k_scale, v_scale
+                )
+            )
+        except PageExhaustedError as exc:
+            # The receiving arena is full even after eviction — the
+            # same typed overload ladder as an admission shed.
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc)
+            )
+        except (KVTransferError, ValueError) as exc:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, str(exc)
+            )
+        self._transfer_stats["kv_transfer_pages_received"] += imported
+        self._transfer_stats["kv_transfer_bytes_received"] += (
+            request.ByteSize()
+        )
+        if request.done:
+            self._transfer_stats["kv_transfers_received"] += 1
+        return serving_pb2.KVTransferResponse(
+            pages_imported=imported, pages_present=present
+        )
+
+    # ------------------------------------------------------------------
     # ModelInfoService
     # ------------------------------------------------------------------
 
@@ -541,6 +817,12 @@ class Sidecar:
         zeros for an embed-only sidecar (no batcher). The kwargs
         construction fails loudly if stats() keys drift from the proto."""
         stats = dict(self.batcher.stats()) if self.batcher is not None else {}
+        # Disaggregated-serving identity + transfer-plane counters: the
+        # role string rides info-style (like mesh_shape) so the
+        # gateway's role-aware router reads it from the same snapshot
+        # it scores load from.
+        stats["role"] = getattr(self.serving, "role", "mixed")
+        stats.update(self._transfer_stats)
         if self.batcher is not None:
             # Sidecar-owned grammar compile cache (the batcher/tiers
             # contribute grammar_masked_tokens / grammar_states_in_use).
@@ -731,6 +1013,19 @@ class Sidecar:
                     ),
                 },
             )
+            # Sidecar→sidecar KV-page transfer (serving.role): every
+            # generate sidecar serves the receiving half — a mixed
+            # replica must accept pages too, or a decode-role drain
+            # would leave in-flight transfers nowhere to land.
+            services.append("ggrmcp.tpu.KVTransferService")
+            add_service(
+                self.server, "ggrmcp.tpu.KVTransferService",
+                {"TransferKV": MethodDef(
+                    self.transfer_kv,
+                    serving_pb2.KVTransferRequest,
+                    serving_pb2.KVTransferResponse,
+                )},
+            )
         add_service(
             self.server, "ggrmcp.tpu.ModelInfoService",
             {
@@ -809,6 +1104,14 @@ class Sidecar:
         return self.port
 
     async def stop(self) -> None:
+        for channel in self._peer_channels.values():
+            try:
+                await channel.close()
+            except asyncio.CancelledError:
+                raise  # a cancelled shutdown must not swallow itself
+            except Exception:  # noqa: BLE001 — peer may already be gone
+                pass
+        self._peer_channels.clear()
         if self.spec_batcher is not None:
             await self.spec_batcher.stop()
         if self.batcher is not None:

@@ -19,6 +19,10 @@ _DTYPES = {
     "int64": np.int64,
     "bool": np.bool_,
     "uint8": np.uint8,
+    # int8 KV pages ride the TransferKV plane verbatim (half the bytes
+    # of bf16) — without this entry to_proto would silently widen them
+    # to float32, quadrupling the wire cost.
+    "int8": np.int8,
 }
 
 

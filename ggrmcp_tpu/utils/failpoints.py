@@ -26,6 +26,11 @@ Hook sites (the names the serving plane evaluates):
   page_exhausted same site, per paged-KV row — forces the page
                  allocator's exhaustion path (typed RESOURCE_EXHAUSTED
                  shed; batching.paged_kv=on only)
+  kv_transfer_fail Sidecar._prefill_and_ship — before the disaggregated
+                 prefill leg exports/ships KV pages: the transfer
+                 "fails" typed (gRPC ABORTED) and the gateway retries
+                 the request on a mixed replica with bit-identical
+                 greedy output (tests/test_disagg.py)
   reconnect_fail ServiceDiscoverer._try_reconnect — before dialing
   backend_down   ServiceDiscoverer.invoke_*_by_tool — after routing,
                  before the gRPC call: the routed replica "dies" (call

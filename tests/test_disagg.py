@@ -1,0 +1,730 @@
+"""Disaggregated prefill/decode serving net (marker `disagg`, tier-1):
+page-chain export/import on the host allocator, batcher-level KV
+shipping with greedy bit-identity vs a mixed replica, the
+sidecar→sidecar TransferKV RPC end to end, role-aware routing
+(prefill-replica isolation, the two-leg plan, typed steer_prefill
+rejection, mixed-fleet bit-for-bit regression), the kv_transfer_fail
+chaos contract (typed retry on a mixed replica, bit-identical output),
+and drain-during-role-flip losing zero in-flight calls.
+"""
+
+import asyncio
+import contextlib
+import itertools
+
+import grpc
+import grpc.aio
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.core import config as cfgmod
+from ggrmcp_tpu.core.config import (
+    BatchingConfig,
+    Config,
+    GRPCConfig,
+    MeshConfig,
+    RoutingConfig,
+    ServingConfig,
+)
+from ggrmcp_tpu.models import llama
+from ggrmcp_tpu.ops.sampling import SamplingConfig
+from ggrmcp_tpu.rpc.discovery import ServiceDiscoverer
+from ggrmcp_tpu.rpc.pb import serving_pb2
+from ggrmcp_tpu.rpc.router import (
+    COUNTER_NAMES,
+    ReplicaRouter,
+    RoleConfigError,
+)
+from ggrmcp_tpu.serving.batching import ContinuousBatcher, KVTransferError
+from ggrmcp_tpu.serving.engine import GenerationEngine
+from ggrmcp_tpu.serving.pages import PageAllocator, PageExhaustedError
+from ggrmcp_tpu.serving.sidecar import Sidecar
+from ggrmcp_tpu.utils import failpoints
+
+pytestmark = pytest.mark.disagg
+
+GEN_TOOL = "ggrmcp_tpu_generateservice_generate"
+STREAM_TOOL = "ggrmcp_tpu_generateservice_generatestream"
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return GenerationEngine(
+        llama.CONFIGS["tiny-llama"],
+        ServingConfig(mesh=MeshConfig(tensor=2, data=0)),
+    )
+
+
+def paged_cfg(**kw) -> BatchingConfig:
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("kv_cache_max_seq", 256)
+    kw.setdefault("paged_kv", "on")
+    kw.setdefault("paged_kv_page_size", 8)
+    return BatchingConfig(**kw)
+
+
+def prompt_of(n: int, salt: int = 0) -> list[int]:
+    return [(i * 13 + salt * 71 + 5) % 500 + 1 for i in range(n)]
+
+
+async def collect(batcher, prompt, max_new, seed=0):
+    out: list[int] = []
+    reason = None
+    async for ids, reason in batcher.submit(
+        prompt, max_new, SamplingConfig(temperature=0.0), seed=seed
+    ):
+        out.extend(ids)
+    return out, reason
+
+
+# ---------------------------------------------------------------------------
+# Host allocator: chain export + import (no device)
+# ---------------------------------------------------------------------------
+
+
+class TestPageChainExportImport:
+    def _registered(self, alloc, prompt):
+        adm = alloc.admit(0, prompt, len(prompt) + 4)
+        assert adm.pages_shared == 0
+        alloc.register(0, prompt)
+        return adm
+
+    def test_chain_pages_walks_the_registered_chain(self):
+        alloc = PageAllocator(16, 4, slots=2, table_width=8)
+        prompt = prompt_of(18)  # 4 full pages + tail
+        self._registered(alloc, prompt)
+        pages = alloc.chain_pages(prompt)
+        assert len(pages) == 4
+        assert pages == [int(p) for p in alloc.tables[0][:4]]
+        # A different prompt shares nothing.
+        assert alloc.chain_pages(prompt_of(18, salt=3)) == []
+
+    def test_import_chain_registers_evictable_pages(self):
+        alloc = PageAllocator(16, 4, slots=2, table_width=8)
+        prompt = prompt_of(16)
+        placed = alloc.import_chain(prompt, 0, 4)
+        assert [j for j, _ in placed] == [0, 1, 2, 3]
+        assert alloc.chain_pages(prompt) == [p for _, p in placed]
+        # Refcount 0 + stamped: evictable cache, like a finished
+        # request's indexed pages.
+        for _, page in placed:
+            assert alloc._ref[page] == 0
+            assert page in alloc._stamp
+        # An admission for the same prompt shares them (skips prefill
+        # of every full page below the reuse cap).
+        adm = alloc.admit(0, prompt, len(prompt) + 4)
+        assert adm.pages_shared == 3  # reuse caps at len(prompt) - 1
+        assert adm.merge_start == 12
+
+    def test_import_chain_dedups_resident_pages(self):
+        alloc = PageAllocator(16, 4, slots=2, table_width=8)
+        prompt = prompt_of(16)
+        first = alloc.import_chain(prompt, 0, 4)
+        again = alloc.import_chain(prompt, 0, 4)
+        assert len(first) == 4 and again == []
+
+    def test_import_chain_is_all_or_nothing_on_exhaustion(self):
+        alloc = PageAllocator(2, 4, slots=1, table_width=8)
+        prompt = prompt_of(16)
+        with pytest.raises(PageExhaustedError):
+            alloc.import_chain(prompt, 0, 4)
+        assert alloc.in_use() == 0 and alloc.chain_pages(prompt) == []
+
+    def test_import_chain_rejects_bad_range(self):
+        alloc = PageAllocator(8, 4, slots=1, table_width=8)
+        with pytest.raises(ValueError, match="outside the prompt"):
+            alloc.import_chain(prompt_of(10), 0, 3)  # only 2 full pages
+
+
+# ---------------------------------------------------------------------------
+# Batcher-level shipping: export → import → decode, bit-identical
+# ---------------------------------------------------------------------------
+
+
+class TestBatcherShipBitIdentity:
+    async def _ship(self, src, dst, prompt):
+        export = await src.run_host_op(
+            lambda: src.export_prompt_kv(prompt)
+        )
+        imported, present = await dst.run_host_op(
+            lambda: dst.import_prompt_kv(
+                prompt, 0, export["k"], export["v"],
+                export.get("k_scale"), export.get("v_scale"),
+            )
+        )
+        return export, imported, present
+
+    @pytest.mark.parametrize("n_prompt", [50, 140])
+    async def test_shipped_pages_decode_bit_identical(
+        self, engine, n_prompt
+    ):
+        """The headline contract: prefill-on-A / decode-on-B via
+        shipped pages produces the exact greedy tokens of the same
+        request on one mixed replica — short prompts ride the fused
+        admission, long ones the chunked grid (n_prompt spans both)."""
+        prompt = prompt_of(n_prompt, salt=n_prompt)
+        A = ContinuousBatcher(engine, paged_cfg())
+        B = ContinuousBatcher(engine, paged_cfg())
+        M = ContinuousBatcher(engine, paged_cfg())
+        A.start()
+        B.start()
+        M.start()
+        try:
+            out_a, _ = await collect(A, prompt, 1)  # prefill leg
+            assert len(out_a) == 1
+            export, imported, present = await self._ship(A, B, prompt)
+            assert export["pages"] == len(prompt) // 8
+            assert imported == export["pages"] and present == 0
+            out_b, reason_b = await collect(B, prompt, 12)
+            out_m, reason_m = await collect(M, prompt, 12)
+            assert (out_b, reason_b) == (out_m, reason_m)
+            # B skipped prefill for every shipped page below the
+            # reuse cap — page-granular proof, not a binary hit flag.
+            assert B.pages.pages_reused >= export["pages"] - 1
+            assert B.pages.hits == 1
+        finally:
+            await A.stop()
+            await B.stop()
+            await M.stop()
+
+    async def test_near_limit_prompt_clamps_consistently(self, engine):
+        """A prompt past the cache limit: fit_request keeps the TAIL,
+        sized by max_new — the prefill leg must clamp with the
+        request's real max_new (clamp_prompt) so its exported chain is
+        the one the decode replica's own clamped admission looks up."""
+        prompt = prompt_of(300, salt=5)  # > kv_cache_max_seq (256)
+        max_new = 12
+        A = ContinuousBatcher(engine, paged_cfg())
+        B = ContinuousBatcher(engine, paged_cfg())
+        M = ContinuousBatcher(engine, paged_cfg())
+        A.start()
+        B.start()
+        M.start()
+        try:
+            clamped = A.clamp_prompt(prompt, max_new)
+            assert clamped == prompt[-(256 - max_new - 1):]
+            await collect(A, clamped, 1)
+            export, imported, _ = await self._ship(A, B, clamped)
+            assert imported == export["pages"] > 0
+            out_b, _ = await collect(B, prompt, max_new)
+            out_m, _ = await collect(M, prompt, max_new)
+            assert out_b == out_m
+            assert B.pages.pages_reused >= export["pages"] - 1
+        finally:
+            await A.stop()
+            await B.stop()
+            await M.stop()
+
+    async def test_export_without_paging_is_typed(self, engine):
+        flat = ContinuousBatcher(
+            engine, BatchingConfig(max_batch_size=2, kv_cache_max_seq=256)
+        )
+        flat.start()
+        try:
+            with pytest.raises(KVTransferError, match="paged_kv"):
+                await flat.run_host_op(
+                    lambda: flat.export_prompt_kv(prompt_of(32))
+                )
+        finally:
+            await flat.stop()
+
+    async def test_export_unindexed_prompt_is_typed(self, engine):
+        b = ContinuousBatcher(engine, paged_cfg())
+        b.start()
+        try:
+            with pytest.raises(KVTransferError, match="no indexed pages"):
+                await b.run_host_op(
+                    lambda: b.export_prompt_kv(prompt_of(32))
+                )
+        finally:
+            await b.stop()
+
+    async def test_import_geometry_mismatch_is_typed(self, engine):
+        b = ContinuousBatcher(engine, paged_cfg())
+        b.start()
+        try:
+            cfg = engine.cfg
+            bad = np.zeros(
+                (cfg.num_layers, 2, 4, cfg.num_kv_heads, cfg.head_dim),
+                np.float32,
+            )  # wrong page_size dim (4 != 8)
+            with pytest.raises(KVTransferError, match="geometry"):
+                await b.run_host_op(
+                    lambda: b.import_prompt_kv(prompt_of(16), 0, bad, bad)
+                )
+            # Scale presence must match the arena's KV dtype too.
+            good = np.zeros(
+                (cfg.num_layers, 2, 8, cfg.num_kv_heads, cfg.head_dim),
+                np.float32,
+            )
+            scale = np.zeros(good.shape[:-1] + (1,), np.float32)
+            with pytest.raises(KVTransferError, match="dtype"):
+                await b.run_host_op(
+                    lambda: b.import_prompt_kv(
+                        prompt_of(16), 0, good, good, scale, scale
+                    )
+                )
+        finally:
+            await b.stop()
+
+    async def test_int8_kv_ships_half_the_bytes_bit_identical(self):
+        """int8 KV pages ride the wire as int8 values + scales: the
+        transfer is ~half the bf16/f32 bytes and the decode replica's
+        greedy output stays bit-identical to its own mixed run."""
+        serving = ServingConfig(
+            mesh=MeshConfig(tensor=2, data=0), kv_cache_dtype="int8"
+        )
+        eng8 = GenerationEngine(llama.CONFIGS["tiny-llama"], serving)
+        prompt = prompt_of(50, salt=9)
+        A = ContinuousBatcher(eng8, paged_cfg())
+        B = ContinuousBatcher(eng8, paged_cfg())
+        M = ContinuousBatcher(eng8, paged_cfg())
+        A.start()
+        B.start()
+        M.start()
+        try:
+            await collect(A, prompt, 1)
+            export, imported, _ = await self._ship(A, B, prompt)
+            assert export["k"].dtype == np.int8 and "k_scale" in export
+            assert imported == export["pages"]
+            out_b, _ = await collect(B, prompt, 10)
+            out_m, _ = await collect(M, prompt, 10)
+            assert out_b == out_m
+        finally:
+            await A.stop()
+            await B.stop()
+            await M.stop()
+
+
+# ---------------------------------------------------------------------------
+# Role-aware routing (no engines)
+# ---------------------------------------------------------------------------
+
+
+class RoleBackend:
+    def __init__(self, target: str, role: str = "mixed"):
+        self.target = target
+        self.role = role
+        self.healthy = True
+        self.draining = False
+        self.invoker = object()
+
+    def __repr__(self):
+        return f"RoleBackend({self.target}, {self.role})"
+
+
+def role_router(**cfg_kw) -> ReplicaRouter:
+    return ReplicaRouter(RoutingConfig(**cfg_kw), stats_view=lambda: ([], 0.0))
+
+
+class TestRoleAwareRouting:
+    def test_prefill_replicas_excluded_from_ordinary_picks(self):
+        router = role_router()
+        pool = [
+            RoleBackend("p:1", "prefill"),
+            RoleBackend("d:1", "decode"),
+            RoleBackend("m:1", "mixed"),
+        ]
+        targets = {router.pick("t", pool).target for _ in range(12)}
+        assert targets == {"d:1", "m:1"}
+
+    def test_all_prefill_pool_degrades_loudly_to_serving(self, caplog):
+        router = role_router()
+        pool = [RoleBackend("p:1", "prefill"), RoleBackend("p:2", "prefill")]
+        with caplog.at_level("WARNING", logger="ggrmcp.rpc.router"):
+            chosen = router.pick("t", pool)
+        assert chosen.target in ("p:1", "p:2")
+        assert any("role=prefill" in r.message for r in caplog.records)
+
+    def test_plan_disagg_splits_prefill_and_decode(self):
+        router = role_router(disagg_min_prompt_tokens=64)
+        pool = [
+            RoleBackend("p:1", "prefill"),
+            RoleBackend("d:1", "decode"),
+            RoleBackend("m:1", "mixed"),
+        ]
+        plan = router.plan_disagg("t", pool, est_prefill_tokens=100)
+        assert plan is not None
+        prefill, decode = plan
+        assert prefill.target == "p:1"
+        assert decode.target == "d:1"  # dedicated decode beats mixed
+        counters = router.snapshot()["backends"]
+        assert counters["p:1"]["disagg_prefills"] == 1
+        assert counters["d:1"]["disagg_decodes"] == 1
+
+    def test_plan_disagg_below_threshold_or_roleless_is_none(self):
+        router = role_router(disagg_min_prompt_tokens=64)
+        split = [RoleBackend("p:1", "prefill"), RoleBackend("d:1", "decode")]
+        assert router.plan_disagg("t", split, 10) is None
+        mixed = [RoleBackend("m:1"), RoleBackend("m:2")]
+        assert router.plan_disagg("t", mixed, 100) is None
+        assert (
+            role_router(disagg="off").plan_disagg("t", split, 100) is None
+        )
+
+    def test_pick_fallback_prefers_mixed(self):
+        router = role_router()
+        pool = [
+            RoleBackend("p:1", "prefill"),
+            RoleBackend("d:1", "decode"),
+            RoleBackend("m:1", "mixed"),
+        ]
+        chosen = router.pick_fallback("t", pool)
+        assert chosen.target == "m:1"
+        assert router.snapshot()["backends"]["m:1"]["disagg_fallbacks"] == 1
+
+    def test_steer_prefill_rejected_typed_on_role_split(self):
+        router = role_router(steer_prefill="on")
+        pool = [RoleBackend("p:1", "prefill"), RoleBackend("m:1", "mixed")]
+        with pytest.raises(RoleConfigError, match="superseded"):
+            router.pick("t", pool)
+        with pytest.raises(RoleConfigError, match="disagg"):
+            router.plan_disagg("t", pool, 10_000)
+        # A pure-mixed fleet keeps the (deprecated) heuristic working.
+        mixed = [RoleBackend("m:1"), RoleBackend("m:2")]
+        assert router.pick("t", mixed) in mixed
+
+    def test_mixed_fleet_routes_bit_for_bit_like_pre_role_router(self):
+        """role=mixed everywhere reproduces the PR 10 placement
+        sequence exactly: same per-tool round-robin cursor walk, zero
+        disagg counters, across interleaved multi-tool traffic."""
+        pool = [RoleBackend(f"m:{i}") for i in range(3)]
+        router = role_router()
+        reference: dict[str, itertools.count] = {}
+        for tool in ("a", "b", "a", "a", "b", "c") * 20:
+            cursor = reference.setdefault(tool, itertools.count())
+            expect = pool[next(cursor) % len(pool)]
+            assert router.pick(tool, pool) is expect
+        counters = router.snapshot()["backends"]
+        for counter in counters.values():
+            assert counter["disagg_prefills"] == 0
+            assert counter["disagg_decodes"] == 0
+            assert counter["disagg_fallbacks"] == 0
+
+    def test_counter_names_cover_disagg(self):
+        from ggrmcp_tpu.gateway.metrics import _ROUTING_HELP
+
+        assert {"disagg_prefills", "disagg_decodes", "disagg_fallbacks"} \
+            <= set(COUNTER_NAMES)
+        # Every router counter must have a help descriptor (the metric
+        # family is built by iterating the table).
+        assert set(COUNTER_NAMES) == set(_ROUTING_HELP)
+
+
+class TestDisaggConfig:
+    def _cfg(self, **serving) -> Config:
+        cfg = Config()
+        for key, value in serving.items():
+            setattr(cfg.serving, key, value)
+        return cfg
+
+    def test_roles_validate(self):
+        for role in ("mixed", "prefill", "decode"):
+            cfg = self._cfg(role=role)
+            if role != "mixed":
+                cfg.serving.batching.paged_kv = "on"
+            cfg.validate()
+
+    def test_unknown_role_rejected(self):
+        with pytest.raises(ValueError, match="serving.role"):
+            self._cfg(role="prefil").validate()
+
+    def test_non_mixed_role_requires_paged_kv(self):
+        with pytest.raises(ValueError, match="paged_kv"):
+            self._cfg(role="prefill").validate()
+
+    def test_non_mixed_role_rejects_tiers(self):
+        cfg = self._cfg(role="decode")
+        cfg.serving.batching.paged_kv = "on"
+        cfg.serving.batching.kv_tiers = [[128, 2], [256, 2]]
+        with pytest.raises(ValueError, match="kv_tiers"):
+            cfg.validate()
+
+    def test_steer_prefill_with_role_rejected_naming_migration(self):
+        cfg = self._cfg(role="prefill")
+        cfg.serving.batching.paged_kv = "on"
+        cfg.gateway.routing.steer_prefill = "on"
+        with pytest.raises(ValueError, match="serving.role"):
+            cfg.validate()
+
+    def test_disagg_knob_typed_errors(self):
+        cfg = self._cfg()
+        cfg.gateway.routing.disagg = "maybe"
+        with pytest.raises(ValueError, match="disagg"):
+            cfg.validate()
+        cfg = self._cfg()
+        cfg.gateway.routing.disagg_min_prompt_tokens = 0
+        with pytest.raises(ValueError, match="disagg_min_prompt_tokens"):
+            cfg.validate()
+
+    def test_env_override_path(self):
+        cfg = cfgmod.apply_env(
+            Config(),
+            {
+                "GGRMCP_SERVING_ROLE": "decode",
+                "GGRMCP_SERVING_BATCHING_PAGED_KV": "on",
+                "GGRMCP_GATEWAY_ROUTING_DISAGG_MIN_PROMPT_TOKENS": "512",
+            },
+        )
+        cfg.validate()
+        assert cfg.serving.role == "decode"
+        assert cfg.gateway.routing.disagg_min_prompt_tokens == 512
+
+    def test_sidecar_mirrors_role_validation(self):
+        with pytest.raises(ValueError, match="paged_kv"):
+            Sidecar(ServingConfig(model="tiny-llama", role="prefill"))
+
+
+# ---------------------------------------------------------------------------
+# Sidecar + gateway discovery end to end (real gRPC)
+# ---------------------------------------------------------------------------
+
+
+def sidecar_cfg(role: str, **kw) -> ServingConfig:
+    return ServingConfig(
+        model="tiny-llama", role=role,
+        batching=BatchingConfig(
+            max_batch_size=4, kv_cache_max_seq=256,
+            paged_kv="on", paged_kv_page_size=8,
+        ),
+        **kw,
+    )
+
+
+LONG_PROMPT = "the quick brown fox jumps over the lazy dog " * 4  # 176 B
+GEN_ARGS = {
+    "prompt": LONG_PROMPT, "maxNewTokens": 8, "returnTokens": True,
+}
+
+
+@contextlib.asynccontextmanager
+async def disagg_env(routing=None):
+    """prefill + decode + mixed sidecars behind one discoverer, roles
+    stamped at discovery."""
+    sides = [
+        Sidecar(sidecar_cfg("prefill")),
+        Sidecar(sidecar_cfg("decode")),
+        Sidecar(sidecar_cfg("mixed")),
+    ]
+    for side in sides:
+        await side.start(0)
+    disc = ServiceDiscoverer(
+        [s.target for s in sides], GRPCConfig(connect_timeout_s=5.0),
+        routing=routing or RoutingConfig(disagg_min_prompt_tokens=64),
+    )
+    await disc.connect()
+    await disc.discover_services()
+    try:
+        yield sides, disc
+    finally:
+        await disc.close()
+        for side in sides:
+            await side.stop()
+
+
+class TestDisaggEndToEnd:
+    async def test_roles_stamped_at_discovery(self):
+        async with disagg_env() as ((P, D, M), disc):
+            roles = {b.target: b.role for b in disc.backends}
+            assert roles == {
+                P.target: "prefill", D.target: "decode", M.target: "mixed",
+            }
+            stats = disc.get_service_stats()
+            assert {b["target"]: b["role"] for b in stats["backends"]} == roles
+
+    async def test_two_leg_call_skips_prefill_bit_identical(self):
+        """The tentpole e2e: a long-prompt call splits prefill-on-P /
+        decode-on-D via shipped pages and returns the exact greedy
+        tokens the mixed replica produces for the same request."""
+        async with disagg_env() as ((P, D, M), disc):
+            result = await disc.invoke_by_tool(GEN_TOOL, dict(GEN_ARGS))
+            snap = disc.get_routing_stats()["backends"]
+            assert snap[P.target]["disagg_prefills"] == 1
+            assert snap[D.target]["disagg_decodes"] == 1
+            # D admitted with pre-populated pages: page-granular reuse.
+            assert D.batcher.pages.pages_reused > 0
+            p_stats = await P.get_serving_stats(None, None)
+            d_stats = await D.get_serving_stats(None, None)
+            assert p_stats.role == "prefill"
+            assert p_stats.kv_transfers_sent == 1
+            assert p_stats.kv_transfer_pages_sent > 0
+            assert d_stats.kv_transfers_received == 1
+            assert (
+                d_stats.kv_transfer_bytes_received
+                == p_stats.kv_transfer_bytes_sent
+            )
+            # Bit-identity against the mixed replica, same request.
+            mixed = await disc.backends[2].invoker.invoke(
+                disc.get_method_by_tool(GEN_TOOL), dict(GEN_ARGS), None, 30.0
+            )
+            # Token ids are the bit-identity claim (protojson omits
+            # `text` when the random-init model emits undecodable
+            # bytes).
+            assert result["tokenIds"] == mixed["tokenIds"]
+            assert result.get("text", "") == mixed.get("text", "")
+
+    async def test_short_prompts_never_land_on_prefill_replica(self):
+        async with disagg_env() as ((P, _D, _M), disc):
+            for i in range(6):
+                await disc.invoke_by_tool(
+                    GEN_TOOL, {"prompt": f"hi {i}", "maxNewTokens": 2}
+                )
+            snap = disc.get_routing_stats()["backends"]
+            assert snap.get(P.target, {}).get("routing_picks", 0) == 0
+
+    async def test_streaming_call_takes_the_two_leg_path(self):
+        async with disagg_env() as ((P, D, _M), disc):
+            chunks = []
+            async for chunk in disc.invoke_stream_by_tool(
+                STREAM_TOOL, dict(GEN_ARGS)
+            ):
+                chunks.append(chunk)
+            assert chunks and chunks[-1].get("done")
+            snap = disc.get_routing_stats()["backends"]
+            assert snap[P.target]["disagg_prefills"] == 1
+            assert snap[D.target]["disagg_decodes"] == 1
+
+    async def test_transfer_failure_retries_typed_on_mixed(self):
+        """kv_transfer_fail chaos: the prefill leg fails TYPED (gRPC
+        ABORTED), the gateway retries the whole request on the mixed
+        replica, and the caller sees the bit-identical output — never
+        an error, never a silent recompute-as-success (the failure is
+        counted on both sides)."""
+        async with disagg_env() as ((P, _D, M), disc):
+            baseline = await disc.invoke_by_tool(GEN_TOOL, dict(GEN_ARGS))
+            failpoints.registry.arm("kv_transfer_fail", every=1, times=1)
+            try:
+                retried = await disc.invoke_by_tool(GEN_TOOL, dict(GEN_ARGS))
+            finally:
+                failpoints.registry.disarm()
+            assert retried["tokenIds"] == baseline["tokenIds"]
+            snap = disc.get_routing_stats()["backends"]
+            assert snap[M.target]["disagg_fallbacks"] == 1
+            p_stats = await P.get_serving_stats(None, None)
+            assert p_stats.kv_transfer_failures == 1
+
+    async def test_unreachable_decode_peer_fails_typed_then_falls_back(self):
+        """A transfer whose receiving sidecar is gone: the ship itself
+        fails, the prefill leg surfaces ABORTED, the fallback still
+        completes the request correctly."""
+        async with disagg_env() as ((P, D, M), disc):
+            baseline = await disc.invoke_by_tool(GEN_TOOL, dict(GEN_ARGS))
+            # Kill the decode sidecar's server but keep it in the
+            # candidate set (the watchdog hasn't noticed yet).
+            await D.server.stop(grace=None)
+            retried = await disc.invoke_by_tool(GEN_TOOL, dict(GEN_ARGS))
+            assert retried["tokenIds"] == baseline["tokenIds"]
+            p_stats = await P.get_serving_stats(None, None)
+            assert p_stats.kv_transfer_failures >= 1
+
+    async def test_drain_role_flip_loses_zero_in_flight(self):
+        """The role-flip runbook under load: drain the decode replica
+        mid-burst — every in-flight call finishes correctly, the
+        drained replica takes zero new placements, the fleet (prefill +
+        mixed) keeps serving long prompts through the fallback-free
+        mixed path, and after the flip + rediscovery the new role is
+        live."""
+        async with disagg_env() as ((P, D, M), disc):
+            async def call(i):
+                return await disc.invoke_by_tool(
+                    GEN_TOOL,
+                    {"prompt": LONG_PROMPT + str(i % 2),
+                     "maxNewTokens": 4, "returnTokens": True},
+                )
+
+            in_flight = [asyncio.create_task(call(i)) for i in range(8)]
+            disc.set_draining(D.target, True)  # mid-burst drain
+            results = await asyncio.gather(*in_flight)
+            assert all(r.get("tokenIds") for r in results)  # zero lost
+            d_picks = disc.get_routing_stats()["backends"].get(
+                D.target, {}
+            ).get("routing_picks", 0)
+            # Long prompts still serve while D drains: the plan needs a
+            # decode-capable candidate, and mixed steps in.
+            more = await disc.invoke_by_tool(GEN_TOOL, dict(GEN_ARGS))
+            assert more.get("tokenIds")
+            assert disc.get_routing_stats()["backends"].get(
+                D.target, {}
+            ).get("routing_picks", 0) == d_picks
+            # Flip the drained replica's role (operationally: restart
+            # with new config) and rediscover — the stamp updates.
+            D.serving.role = "mixed"
+            await disc.discover_services()
+            assert {
+                b.target: b.role for b in disc.backends
+            }[D.target] == "mixed"
+            disc.set_draining(D.target, False)
+            final = await disc.invoke_by_tool(GEN_TOOL, dict(GEN_ARGS))
+            assert final.get("tokenIds")
+
+    async def test_page_size_mismatch_rejected_typed(self):
+        """Geometry guards: a receiver with a different page size
+        refuses the import INVALID_ARGUMENT; the prefill leg surfaces
+        it as a typed transfer failure and the caller still gets the
+        right answer via fallback."""
+        P = Sidecar(sidecar_cfg("prefill"))
+        await P.start(0)
+        other = Sidecar(ServingConfig(
+            model="tiny-llama", role="decode",
+            batching=BatchingConfig(
+                max_batch_size=4, kv_cache_max_seq=256,
+                paged_kv="on", paged_kv_page_size=16,
+            ),
+        ))
+        await other.start(0)
+        M = Sidecar(sidecar_cfg("mixed"))
+        await M.start(0)
+        disc = ServiceDiscoverer(
+            [P.target, other.target, M.target],
+            GRPCConfig(connect_timeout_s=5.0),
+            routing=RoutingConfig(disagg_min_prompt_tokens=64),
+        )
+        await disc.connect()
+        await disc.discover_services()
+        try:
+            result = await disc.invoke_by_tool(GEN_TOOL, dict(GEN_ARGS))
+            assert result.get("tokenIds")
+            snap = disc.get_routing_stats()["backends"]
+            assert snap[M.target].get("disagg_fallbacks", 0) == 1
+            p_stats = await P.get_serving_stats(None, None)
+            assert p_stats.kv_transfer_failures == 1
+        finally:
+            await disc.close()
+            for side in (P, other, M):
+                await side.stop()
+
+    async def test_direct_rpc_transfer_roundtrip(self):
+        """The raw RPC surface without a gateway: Generate with
+        kv_transfer_target returns "transferred" and the peer's
+        TransferKV import shows up in its stats."""
+        P = Sidecar(sidecar_cfg("prefill"))
+        await P.start(0)
+        D = Sidecar(sidecar_cfg("decode"))
+        await D.start(0)
+        channel = grpc.aio.insecure_channel(P.target)
+        try:
+            call = channel.unary_unary(
+                "/ggrmcp.tpu.GenerateService/Generate",
+                request_serializer=(
+                    serving_pb2.GenerateRequest.SerializeToString
+                ),
+                response_deserializer=(
+                    serving_pb2.GenerateResponse.FromString
+                ),
+            )
+            resp = await call(
+                serving_pb2.GenerateRequest(
+                    prompt=LONG_PROMPT, max_new_tokens=8,
+                    kv_transfer_target=D.target,
+                ),
+                timeout=60,
+            )
+            assert resp.finish_reason == "transferred"
+            assert not resp.text and not resp.token_ids
+            d_stats = await D.get_serving_stats(None, None)
+            assert d_stats.kv_transfers_received == 1
+            assert d_stats.kv_transfer_pages_received > 0
+        finally:
+            await channel.close()
+            await P.stop()
+            await D.stop()

@@ -114,9 +114,9 @@ class TestProtoDrift:
               for p in ("admit", "sync", "dispatch", "wait", "host")),
         }
         # String fields export info-style (labels carry the value) —
-        # mesh_shape is the first; a new string field lands there by
-        # construction.
-        assert infos == {"mesh_shape"}
+        # mesh_shape was the first, the serving role rides beside it; a
+        # new string field lands there by construction.
+        assert infos == {"mesh_shape", "role"}
         assert not (gauges & infos)
         for field in desc.fields:
             covered = (

@@ -358,8 +358,10 @@ class TestDrainMembership:
         b1, b2 = disc.backends
         state = disc.set_draining(b2.target, True)
         assert state == [
-            {"target": b1.target, "healthy": True, "draining": False},
-            {"target": b2.target, "healthy": True, "draining": True},
+            {"target": b1.target, "healthy": True, "draining": False,
+             "role": "mixed"},
+            {"target": b2.target, "healthy": True, "draining": True,
+             "role": "mixed"},
         ]
         picks = [disc._route(TOOL)[1].target for _ in range(6)]
         assert set(picks) == {b1.target}
