@@ -7,7 +7,8 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: proto proto-check descriptors test test-all test-fast test-chaos \
   test-obs test-grammar test-spec-batch test-paged test-tp test-analysis \
-  test-disagg bench-cpu smoke e2e lint graftlint ci-local preflight clean
+  test-disagg test-fleet bench-cpu smoke e2e lint graftlint ci-local \
+  preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
 # No protoc on this image? scripts/regen_serving_pb2.py regenerates
@@ -131,6 +132,15 @@ test-analysis:
 
 test-disagg:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m disagg
+
+# Self-healing elastic fleet net alone (CPU mesh): supervisor
+# hysteresis + churn budget + min_replicas floor properties, heal with
+# backoff (process exit, health-flap storms), real-process SIGKILL
+# restart drills, launcher sidecar supervision, /admin/fleet on both
+# http impls. Tier-1 runs these too; this target is the fast inner
+# loop for serving/fleet.py work.
+test-fleet:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m fleet
 
 # ruff if present (baked CI image installs it; the TPU image may not).
 lint:

@@ -112,6 +112,28 @@ Environment knobs:
                          GGRMCP_BENCH_DISAGG_LONG_LEN (1200 tokens),
                          GGRMCP_BENCH_DISAGG_SHORT_WORKERS (6),
                          GGRMCP_BENCH_DISAGG_LONG_WORKERS (2).
+  GGRMCP_BENCH_FLEET=1   self-healing elastic fleet phase (standalone
+                         mode, like REPLICAS): a FleetSupervisor-
+                         managed autoscale fleet (serving/fleet.py)
+                         vs EVERY static-N config over a 3-phase
+                         diurnal trace (ramp → spike → trough) of
+                         shed-tolerant loadgen traffic — exports
+                         per-phase ok-calls/s, client p50/p99, shed
+                         counts, mean/max replica count, the
+                         replica-seconds (chip-seconds) integral, and
+                         the typed autoscale action log
+                         (bench_artifacts/fleet_trace.json;
+                         docs/fleet.md). Knobs:
+                         GGRMCP_BENCH_FLEET_MAX (3 — the static sweep
+                         and autoscale ceiling),
+                         GGRMCP_BENCH_FLEET_SLOTS (2),
+                         GGRMCP_BENCH_FLEET_PENDING (2),
+                         GGRMCP_BENCH_FLEET_CALLS (30 per session;
+                         the trough runs 4x calls on its few
+                         sessions so the scale-down window can
+                         elapse in-phase),
+                         GGRMCP_BENCH_FLEET_RAMP/SPIKE/TROUGH
+                         session counts (3/10/1).
   GGRMCP_BENCH_CPU=1     force the CPU platform (tiny model)
 """
 
@@ -2519,6 +2541,242 @@ async def _disagg_bench() -> dict:
     }
 
 
+async def _fleet_bench() -> dict:
+    """Self-healing elastic fleet vs every static-N config over a
+    3-phase diurnal/bursty trace (ROADMAP item 5, docs/fleet.md).
+
+    The traffic shape millions of real users produce and no fixed
+    closed loop ever does: ramp (moderate sessions), spike (heavy),
+    trough (a trickle). Each config drives the SAME trace with
+    shed-tolerant loadgen (429s are the measurement, not a failure):
+
+      * autoscale — FleetSupervisor-managed fleet (min=1,
+        max=GGRMCP_BENCH_FLEET_MAX): spawns on sustained shed,
+        retires on utilization-idle troughs.
+      * static-1 .. static-N — fixed fleets at every size the
+        autoscaler could choose.
+
+    Honest-table contract: every point exports per-phase ok-calls/s,
+    client p50/p99, shed + error counts, mean/max replica count, and
+    the whole-trace replica-seconds integral (the chip-seconds bill).
+    The autoscaler's typed action log + per-phase replica counts land
+    in bench_artifacts/fleet_trace.json so the trace is reviewable —
+    committed to docs/BENCH.md whether the autoscaler wins or not."""
+    import logging
+
+    logging.getLogger("ggrmcp.gateway.http").setLevel(logging.WARNING)
+
+    from ggrmcp_tpu.core import config as cfgmod
+    from ggrmcp_tpu.core.config import FleetConfig
+    from ggrmcp_tpu.gateway.app import Gateway
+    from ggrmcp_tpu.serving.fleet import (
+        FleetSupervisor,
+        GatewayFleetAdapter,
+        ProcessReplicaFactory,
+    )
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tool = "ggrmcp_tpu_generateservice_generate"
+    slots = int(os.environ.get("GGRMCP_BENCH_FLEET_SLOTS", "2"))
+    pending = int(os.environ.get("GGRMCP_BENCH_FLEET_PENDING", "2"))
+    max_replicas = int(os.environ.get("GGRMCP_BENCH_FLEET_MAX", "3"))
+    calls = int(os.environ.get("GGRMCP_BENCH_FLEET_CALLS", "30"))
+    max_new = 8
+    # (phase, sessions, calls-per-session): the trough runs FEW
+    # sessions for LONGER so the scale-down window can actually elapse
+    # inside the phase.
+    # The spike runs 2x calls so it lasts well past the autoscaler's
+    # sustain + replica spawn time (a spike shorter than one spawn
+    # can't be autoscaled by ANY policy); the trough runs 4x calls on
+    # its few sessions so the scale-down window can elapse in-phase.
+    trace = [
+        ("ramp",
+         int(os.environ.get("GGRMCP_BENCH_FLEET_RAMP", "3")), calls),
+        ("spike",
+         int(os.environ.get("GGRMCP_BENCH_FLEET_SPIKE", "10")),
+         calls * 2),
+        ("trough",
+         int(os.environ.get("GGRMCP_BENCH_FLEET_TROUGH", "1")),
+         calls * 6),
+    ]
+    worker_env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "GGRMCP_FLEET_WORKER_MODEL": "tiny-llama",
+        "GGRMCP_FLEET_WORKER_SLOTS": str(slots),
+        "GGRMCP_FLEET_WORKER_MAXSEQ": "256",
+        # Tight bounded admission: the spike MUST shed on an
+        # undersized fleet — sheds are the autoscaler's signal.
+        "GGRMCP_FLEET_WORKER_PENDING": str(pending),
+    }
+
+    async def run_config(
+        label: str, static_n: int = 0, autoscale: bool = False
+    ) -> dict:
+        cfg = cfgmod.default()
+        cfg.server.host = "127.0.0.1"
+        cfg.server.port = 0
+        cfg.server.rate_limit.enabled = False
+        cfg.session.rate_limit.enabled = False
+        cfg.grpc.reconnect.enabled = False
+        cfg.server.request_timeout_s = 600.0
+        cfg.grpc.call_timeout_s = 600.0
+        gateway = Gateway(cfg, targets=[])
+        await gateway.start()
+        factory = ProcessReplicaFactory(env=worker_env, cwd=repo)
+        adapter = GatewayFleetAdapter(
+            gateway.discoverer, factory, stats_max_age_s=1.0
+        )
+        supervisor = None
+        tasks: list[asyncio.Task] = []
+        samples: list[tuple[float, int]] = []
+        try:
+            if autoscale:
+                supervisor = FleetSupervisor(FleetConfig(
+                    min_replicas=1, max_replicas=max_replicas,
+                    # Sustain > worker boot time / 2: on a SHARED host
+                    # each booting replica steals cores from the ones
+                    # serving, so spawning eagerly during a spike makes
+                    # the spike WORSE (measured: two concurrent boots
+                    # doubled spike p99) — one spawn per sustained
+                    # episode, re-evaluated after it lands.
+                    scale_up_sustain_s=3.0, shed_hold_s=2.0,
+                    scale_down_sustain_s=4.0,
+                    decide_interval_s=0.5, drain_grace_s=1.0,
+                    max_actions_per_window=2, action_window_s=15.0,
+                    backoff_base_s=0.5, backoff_max_s=4.0,
+                ), adapter, background_actions=True)
+                gateway.handler.fleet = supervisor
+                await supervisor.run_once()  # floor bootstrap
+                # The bootstrap spawn applies in the background; the
+                # trace measures the CONTROL LOOP, not cold-start, so
+                # wait for the floor replica before opening traffic.
+                deadline = time.monotonic() + 600
+                while time.monotonic() < deadline and not adapter.procs:
+                    await asyncio.sleep(0.25)
+                if not adapter.procs:
+                    raise RuntimeError("fleet bootstrap never completed")
+
+                async def drive() -> None:
+                    while True:
+                        await asyncio.sleep(0.5)
+                        await supervisor.run_once()
+
+                tasks.append(asyncio.create_task(drive()))
+            else:
+                for _ in range(static_n):
+                    await adapter.spawn("static fleet")
+
+            async def sample() -> None:
+                while True:
+                    samples.append(
+                        (time.monotonic(), len(adapter.procs))
+                    )
+                    await asyncio.sleep(0.25)
+
+            tasks.append(asyncio.create_task(sample()))
+            base = f"http://127.0.0.1:{gateway.port}"
+            phases_out: dict[str, dict] = {}
+            for idx, (phase, sessions, phase_calls) in enumerate(trace):
+                template = json.dumps({
+                    "prompt": f"fleet {label} {phase} s{{s}} c{{i}}.",
+                    "maxNewTokens": max_new,
+                })
+                t0 = time.monotonic()
+                [gen] = await _drive_loadgens(
+                    [[
+                        sys.executable,
+                        os.path.join(repo, "scripts", "loadgen.py"),
+                        "--base-url", base,
+                        "--tool", tool,
+                        "--arguments-template", template,
+                        "--sessions", str(sessions),
+                        "--calls-per-session", str(phase_calls),
+                        "--warmup", "1" if idx == 0 else "0",
+                        "--tolerate-errors",
+                    ]],
+                    ready_timeout=600, run_timeout=1800,
+                    capture_stderr=True, label=f"fleet-{label}-{phase}",
+                )
+                t1 = time.monotonic()
+                lat = sorted(gen["latencies_ms"])
+                window = [n for ts, n in samples if t0 <= ts <= t1]
+                elapsed = gen["end"] - gen["start"]
+                phases_out[phase] = {
+                    "sessions": sessions,
+                    "ok_calls": gen["count"],
+                    "sheds": gen["sheds"],
+                    "errors": gen["errors"],
+                    "calls_per_sec": round(
+                        gen["count"] / elapsed, 2
+                    ) if elapsed > 0 else 0.0,
+                    "p50_ms": round(statistics.median(lat), 1) if lat else 0.0,
+                    "p99_ms": round(nearest_rank(lat, 0.99), 1) if lat else 0.0,
+                    "replicas_mean": round(
+                        sum(window) / len(window), 2
+                    ) if window else float(len(adapter.procs)),
+                    "replicas_max": max(window) if window else len(
+                        adapter.procs
+                    ),
+                }
+            replica_seconds = sum(
+                n_a * (t_b - t_a)
+                for (t_a, n_a), (t_b, _n) in zip(samples, samples[1:])
+            )
+            out: dict = {
+                "phases": phases_out,
+                "replica_seconds": round(replica_seconds, 1),
+                "total_sheds": sum(
+                    p["sheds"] for p in phases_out.values()
+                ),
+                "spike_p99_ms": phases_out["spike"]["p99_ms"],
+            }
+            if supervisor is not None:
+                snap = supervisor.snapshot()
+                out["actions"] = snap["actions"]
+                out["counters"] = snap["counters"]
+            return out
+        finally:
+            for task in tasks:
+                task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            if supervisor is not None:
+                gateway.handler.fleet = None
+            await adapter.close()
+            await gateway.stop()
+
+    results = {"autoscale": await run_config("auto", autoscale=True)}
+    for n in range(1, max_replicas + 1):
+        results[f"static_{n}"] = await run_config(f"s{n}", static_n=n)
+
+    # Reviewable trace artifact: the typed action log + per-phase
+    # replica counts for every config.
+    os.makedirs(_ARTIFACT_DIR, exist_ok=True)
+    with open(os.path.join(_ARTIFACT_DIR, "fleet_trace.json"), "w") as f:
+        json.dump(results, f, indent=2)
+
+    auto = results["autoscale"]
+    statics = {
+        name: r for name, r in results.items() if name != "autoscale"
+    }
+    return {
+        "fleet_trace": results,
+        "fleet_auto_spike_p99_ms": auto["spike_p99_ms"],
+        "fleet_auto_sheds": auto["total_sheds"],
+        "fleet_auto_replica_seconds": auto["replica_seconds"],
+        "fleet_auto_actions": len(auto.get("actions", [])),
+        "fleet_static_spike_p99_ms": {
+            name: r["spike_p99_ms"] for name, r in statics.items()
+        },
+        "fleet_static_sheds": {
+            name: r["total_sheds"] for name, r in statics.items()
+        },
+        "fleet_static_replica_seconds": {
+            name: r["replica_seconds"] for name, r in statics.items()
+        },
+    }
+
+
 _ARTIFACT_DIR = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "bench_artifacts"
 )
@@ -2687,6 +2945,18 @@ def main() -> None:
             "metric": "disagg_split_tokens_per_sec",
             "value": result["disagg_split"]["tokens_per_sec"],
             "unit": "tokens/s", **result,
+        }))
+        return
+
+    if os.environ.get("GGRMCP_BENCH_FLEET") == "1":
+        # Standalone elastic-fleet phase (like REPLICAS/DISAGG):
+        # supervisor-managed autoscale vs every static-N over the
+        # 3-phase diurnal trace; replicas are CPU host processes.
+        result = asyncio.run(_fleet_bench())
+        _emit(json.dumps({
+            "metric": "fleet_auto_spike_p99_ms",
+            "value": result["fleet_auto_spike_p99_ms"],
+            "unit": "ms", **result,
         }))
         return
 

@@ -586,6 +586,71 @@ class RoutingConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Self-healing elastic fleet supervisor (serving/fleet.py,
+    docs/fleet.md). The closed observe→decide→act loop over a set of
+    replica child processes: scale up on sustained shed, drain+retire
+    on sustained idle, and heal — restart a replica whose process
+    exits or whose health flaps — with exponential backoff + jitter,
+    all under a max-churn budget so the supervisor provably cannot
+    flap itself. Every decision is a typed FleetAction with a reason;
+    `POST /admin/fleet?action=pause|resume` gates the whole loop."""
+
+    enabled: bool = False
+    # Replica-count floor/ceiling. The supervisor NEVER drains or
+    # retires below min_replicas — including during heal actions
+    # (tests/test_fleet.py property suite) — and never spawns above
+    # max_replicas.
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # Scale-up pressure signals: sustained shed (any backend's
+    # shed_requests counter rising) or windowed backend TTFT p99 above
+    # this SLO target (ms).
+    slo_ttft_p99_ms: float = 2000.0
+    # A shed-counter rise asserts pressure for this long (seconds).
+    # The ServingStats snapshot refreshes slower than the decide loop
+    # ticks, so without the hold, consecutive observes of the SAME
+    # cached counter would reset the sustain clock between every
+    # refresh and pressure could never accumulate. Must stay below
+    # scale_up_sustain_s or a single rise could fake a sustained
+    # episode (validate() enforces it). 0 = no hold (a rise counts
+    # only on the step that sees it — deterministic-test mode).
+    shed_hold_s: float = 6.0
+    # Hysteresis gates: pressure/idle must hold this long before ONE
+    # action fires (then the clock re-arms — a sustained episode
+    # produces one spawn per sustain period, never a double-spawn).
+    scale_up_sustain_s: float = 10.0
+    scale_down_sustain_s: float = 60.0
+    # Heal trigger: this many health transitions (healthy↔unhealthy
+    # edges) within flap_window_s marks a replica flapping — it is
+    # drained (when the pool floor allows), killed, and restarted.
+    flap_threshold: int = 3
+    flap_window_s: float = 60.0
+    # Churn budget: state-changing actions (spawn/drain/kill/restart)
+    # allowed per sliding action_window_s. Exhausted budget suppresses
+    # further actions (counted + logged) — the supervisor's own
+    # anti-flap bound.
+    max_actions_per_window: int = 4
+    action_window_s: float = 60.0
+    # Restart backoff: min(backoff_max_s, backoff_base_s * 2^attempt)
+    # plus up to backoff_jitter fraction of that (deterministic
+    # per-supervisor RNG), so a crash-looping fleet doesn't
+    # thundering-herd its own restarts. After restart_max_attempts
+    # consecutive failed restarts the replica is given up (retired
+    # loudly) and a fresh spawn replaces it when below min_replicas.
+    backoff_base_s: float = 1.0
+    backoff_max_s: float = 60.0
+    backoff_jitter: float = 0.2
+    restart_max_attempts: int = 5
+    # Control-loop period (observe→decide→act) and the grace between
+    # draining a retiring replica and killing it.
+    decide_interval_s: float = 2.0
+    drain_grace_s: float = 10.0
+    # Bounded action-log ring exported on /stats and /debug/requests.
+    action_log: int = 256
+
+
+@dataclass
 class GatewayConfig:
     """Gateway-side behavior knobs (no reference analogue)."""
 
@@ -764,6 +829,7 @@ class Config:
     tools: ToolsConfig = field(default_factory=ToolsConfig)
     gateway: GatewayConfig = field(default_factory=GatewayConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     training: TrainingConfig = field(default_factory=TrainingConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
@@ -941,6 +1007,49 @@ class Config:
             raise ValueError(
                 "gateway.routing.disagg_min_prompt_tokens must be >= 1"
             )
+        fleet = self.fleet
+        if fleet.min_replicas < 1:
+            raise ValueError("fleet.min_replicas must be >= 1")
+        if fleet.max_replicas < fleet.min_replicas:
+            raise ValueError(
+                "fleet.max_replicas must be >= fleet.min_replicas"
+            )
+        if fleet.slo_ttft_p99_ms <= 0:
+            raise ValueError("fleet.slo_ttft_p99_ms must be > 0")
+        if fleet.scale_up_sustain_s <= 0 or fleet.scale_down_sustain_s <= 0:
+            raise ValueError(
+                "fleet.scale_up_sustain_s/scale_down_sustain_s must be > 0"
+            )
+        if not (0 <= fleet.shed_hold_s < fleet.scale_up_sustain_s):
+            raise ValueError(
+                "fleet.shed_hold_s must be >= 0 and < scale_up_sustain_s "
+                "(a single shed rise must never fake a sustained episode)"
+            )
+        if fleet.flap_threshold < 2:
+            # One transition is any ordinary failure; flapping needs at
+            # least a down-up pair to be distinguishable from a crash.
+            raise ValueError("fleet.flap_threshold must be >= 2")
+        if fleet.flap_window_s <= 0 or fleet.action_window_s <= 0:
+            raise ValueError(
+                "fleet.flap_window_s/action_window_s must be > 0"
+            )
+        if fleet.max_actions_per_window < 1:
+            raise ValueError("fleet.max_actions_per_window must be >= 1")
+        if fleet.backoff_base_s <= 0 or fleet.backoff_max_s < fleet.backoff_base_s:
+            raise ValueError(
+                "fleet.backoff_base_s must be > 0 and <= fleet.backoff_max_s"
+            )
+        if not (0 <= fleet.backoff_jitter < 1):
+            raise ValueError("fleet.backoff_jitter must be in [0, 1)")
+        if fleet.restart_max_attempts < 1:
+            raise ValueError("fleet.restart_max_attempts must be >= 1")
+        if fleet.decide_interval_s <= 0:
+            raise ValueError("fleet.decide_interval_s must be > 0")
+        if fleet.drain_grace_s < 0:
+            raise ValueError("fleet.drain_grace_s must be >= 0 (0 = kill "
+                             "immediately after drain)")
+        if fleet.action_log < 1:
+            raise ValueError("fleet.action_log must be >= 1")
         if self.serving.role not in SERVING_ROLES:
             raise ValueError(
                 f"unknown serving.role {self.serving.role!r}; "
@@ -1187,12 +1296,14 @@ _ENV_PREFIX = "GGRMCP_"
 # GGRMCP_-prefixed control vars that are NOT config-tree paths: the
 # chaos registry reads GGRMCP_FAILPOINTS at import
 # (utils/failpoints.py), setup_logging reads GGRMCP_LOG_JSON
-# (gateway/app.py), and GGRMCP_BENCH_* are bench knobs that leak into
-# co-launched serving processes' environments. Without the skip, a
-# process launched with any of them dies at config load with
-# "unknown config env var".
+# (gateway/app.py), GGRMCP_BENCH_* are bench knobs that leak into
+# co-launched serving processes' environments, and
+# GGRMCP_FLEET_WORKER_* is the fleet replica-worker spawn handshake
+# (serving/fleet.py — read directly by the worker, never a config
+# path). Without the skip, a process launched with any of them dies at
+# config load with "unknown config env var".
 _ENV_SKIP = frozenset({"GGRMCP_FAILPOINTS", "GGRMCP_LOG_JSON"})
-_ENV_SKIP_PREFIXES = ("GGRMCP_BENCH_",)
+_ENV_SKIP_PREFIXES = ("GGRMCP_BENCH_", "GGRMCP_FLEET_WORKER_")
 
 
 def apply_env(cfg: Config, environ: Optional[dict[str, str]] = None) -> Config:

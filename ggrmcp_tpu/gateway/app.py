@@ -72,6 +72,11 @@ class Gateway:
         self._site: Optional[web.TCPSite] = None
         self._fastlane = None
         self.port = cfg.server.port
+        # Elastic fleet supervisor (serving/fleet.py) — built in
+        # start() when cfg.fleet.enabled; its child replicas join the
+        # discoverer at runtime via add_backend.
+        self.fleet = None
+        self._fleet_adapter = None
 
     def _build_app(self) -> web.Application:
         app = web.Application(
@@ -96,6 +101,7 @@ class Gateway:
         app.router.add_post(
             "/admin/undrain", self.handler.handle_admin_undrain
         )
+        app.router.add_post("/admin/fleet", self.handler.handle_admin_fleet)
         return app
 
     async def start(
@@ -107,12 +113,20 @@ class Gateway:
             except ConnectionError as exc:
                 # Fail-fast startup like the reference (main.go:152-170)
                 # unless reconnection is enabled — then serve degraded and
-                # let the watchdog recover the backends.
-                if not self.cfg.grpc.reconnect.enabled:
+                # let the watchdog recover the backends. A fleet-enabled
+                # gateway also starts degraded: its supervisor spawns the
+                # replica pool moments later, so dying on an unreachable
+                # static placeholder would be a bootstrap dead-end.
+                if not (
+                    self.cfg.grpc.reconnect.enabled
+                    or self.cfg.fleet.enabled
+                ):
                     raise
                 logger.warning("starting degraded: %s", exc)
         await self.discoverer.discover_services()
         self.discoverer.start_watchdog()
+        if self.cfg.fleet.enabled:
+            self._start_fleet()
 
         if self.cfg.server.http_impl == "fastlane":
             from ggrmcp_tpu.gateway.fastlane import FastLaneServer
@@ -148,8 +162,57 @@ class Gateway:
             self.cfg.server.http_impl,
         )
 
+    def _start_fleet(self) -> None:
+        """Build + start the fleet supervisor (cfg.fleet.enabled):
+        child sidecar workers inherit the serving config through the
+        GGRMCP_FLEET_WORKER_* env handshake, observation/actuation ride
+        the discoverer. Statically configured backends stay OUTSIDE the
+        supervisor's pool — it grows/shrinks/heals only replicas it
+        spawned (the floor pass bootstraps min_replicas of them)."""
+        import os as _os
+
+        from ggrmcp_tpu.serving.fleet import (
+            FleetSupervisor,
+            GatewayFleetAdapter,
+            ProcessReplicaFactory,
+        )
+
+        serving = self.cfg.serving
+        env = dict(_os.environ)
+        env.update({
+            "GGRMCP_FLEET_WORKER_MODEL": serving.model,
+            "GGRMCP_FLEET_WORKER_ROLE": serving.role,
+            "GGRMCP_FLEET_WORKER_SLOTS":
+                str(serving.batching.max_batch_size),
+            "GGRMCP_FLEET_WORKER_MAXSEQ":
+                str(serving.batching.kv_cache_max_seq),
+            "GGRMCP_FLEET_WORKER_PAGED": serving.batching.paged_kv,
+        })
+        self._fleet_adapter = GatewayFleetAdapter(
+            self.discoverer, ProcessReplicaFactory(env=env)
+        )
+        self.fleet = FleetSupervisor(
+            self.cfg.fleet, self._fleet_adapter,
+            # Replica boots take tens of seconds of JAX warmup; inline
+            # applies would wedge every other policy for the duration.
+            background_actions=True,
+        )
+        self.handler.fleet = self.fleet
+        self.fleet.start()
+        logger.info(
+            "fleet supervisor started (min=%d max=%d, interval %.1fs)",
+            self.cfg.fleet.min_replicas, self.cfg.fleet.max_replicas,
+            self.cfg.fleet.decide_interval_s,
+        )
+
     async def stop(self) -> None:
         """Graceful shutdown with drain (main.go:94-112)."""
+        if self.fleet is not None:
+            await self.fleet.stop()
+            await self._fleet_adapter.close()
+            self.handler.fleet = None
+            self.fleet = None
+            self._fleet_adapter = None
         await self.discoverer.stop_watchdog()
         if self._fastlane is not None:
             await asyncio.wait_for(

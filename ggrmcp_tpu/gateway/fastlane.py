@@ -599,6 +599,16 @@ class FastLaneServer:
             )
             self._write_json(conn, headers, status, body_dict)
             return status
+        if path == "/admin/fleet":
+            if method != "POST":
+                self._write_response(conn, headers, 405, None, b"")
+                return 405
+            query = parse_qs(urlsplit(target).query)
+            body_dict, status = h.admin_fleet_body(
+                query.get("action", ["status"])[0]
+            )
+            self._write_json(conn, headers, status, body_dict)
+            return status
         if method != "GET":
             self._write_response(conn, headers, 405, None, b"")
             return 405

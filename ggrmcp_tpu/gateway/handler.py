@@ -120,6 +120,11 @@ class MCPHandler:
         # shed count seen across backends and when it last increased.
         self._shed_seen = 0.0
         self._shed_last_rise = float("-inf")
+        # Fleet supervisor (serving/fleet.py), attached by the Gateway
+        # when fleet.enabled (or by a bench/chaos harness). None =
+        # static fleet; /admin/fleet then 404s and /stats omits the
+        # fleet section.
+        self.fleet = None
 
     # ------------------------------------------------------------------
     # HTTP entry points
@@ -627,6 +632,8 @@ class MCPHandler:
             await self.discoverer.get_serving_stats_snapshot()
         )
         self.metrics.set_routing_stats(self.discoverer.get_routing_stats())
+        if self.fleet is not None:
+            self.metrics.set_fleet_stats(self.fleet.snapshot())
         payload, content_type = self.metrics.render()
         return payload, content_type.split(";")[0]
 
@@ -640,6 +647,8 @@ class MCPHandler:
         stats = self.discoverer.get_service_stats()
         stats["sessions"] = self.sessions.stats()
         stats["routing"] = self.discoverer.get_routing_stats()
+        if self.fleet is not None:
+            stats["fleet"] = self.fleet.snapshot()
         serving = await self.discoverer.get_backend_serving_stats()
         if serving:
             stats["serving"] = serving
@@ -696,6 +705,35 @@ class MCPHandler:
     ) -> web.Response:
         body, status = self.admin_drain_body(
             request.query.get("backend", ""), drain=False
+        )
+        return web.json_response(body, status=status)
+
+    def admin_fleet_body(self, action: str) -> tuple[dict[str, Any], int]:
+        """POST /admin/fleet?action=pause|resume|status core: gate the
+        fleet supervisor's whole decide loop (docs/fleet.md runbook —
+        pause before manual surgery, resume after; status is the same
+        snapshot /stats carries). 404 when no supervisor is attached
+        (fleet.enabled=false), 400 on an unknown action. Framework-
+        free, shared by both HTTP impls."""
+        if self.fleet is None:
+            return {
+                "error": "no fleet supervisor attached "
+                         "(fleet.enabled=false)",
+            }, 404
+        if action == "pause":
+            self.fleet.pause()
+        elif action == "resume":
+            self.fleet.resume()
+        elif action not in ("", "status"):
+            return {
+                "error": f"unknown action: {action}",
+                "actions": ["pause", "resume", "status"],
+            }, 400
+        return {"fleet": self.fleet.snapshot()}, 200
+
+    async def handle_admin_fleet(self, request: web.Request) -> web.Response:
+        body, status = self.admin_fleet_body(
+            request.query.get("action", "status")
         )
         return web.json_response(body, status=status)
 
@@ -767,8 +805,12 @@ class MCPHandler:
         else:
             # /debug/requests answers "why did THIS call go THERE":
             # the router's policy + per-backend placement counters ride
-            # alongside the lifecycle records (docs/routing.md).
+            # alongside the lifecycle records (docs/routing.md), and —
+            # with a fleet supervisor attached — the typed action log
+            # answers "why did the POOL change" (docs/fleet.md).
             body["routing"] = self.discoverer.get_routing_stats()
+            if self.fleet is not None:
+                body["fleet"] = self.fleet.snapshot()
         return body
 
     async def handle_debug_ticks(self, request: web.Request) -> web.Response:

@@ -177,6 +177,31 @@ _ROUTING_HELP = {
         "KV-transfer failure",
 }
 
+# Fleet-supervisor counter help (serving/fleet.py COUNTER_NAMES): each
+# exports as gateway_fleet_<name> (pool-level — the supervisor is one
+# loop, not per-target). Built by iterating THIS table, so "added a
+# counter, forgot the metric" is impossible; tests/test_fleet.py
+# asserts the table stays in sync with fleet.COUNTER_NAMES.
+_FLEET_HELP = {
+    "spawns": "replicas spawned (scale-up, floor top-up, restarts' "
+              "spawn half is counted under restarts)",
+    "drains": "replicas drained by the supervisor (retire or flap heal)",
+    "undrains": "supervisor un-drain actions",
+    "kills": "replica processes hard-killed",
+    "restarts": "replica restart actions (dead process or flap heal)",
+    "retires": "replicas retired after a completed scale-down drain",
+    "give_ups": "replicas abandoned after restart_max_attempts "
+                "consecutive failed restarts",
+    "flap_heals": "heal cycles triggered by fleet.flap_threshold "
+                  "health transitions",
+    "suppressed_churn": "decisions withheld by the "
+                        "fleet.max_actions_per_window churn budget",
+    "suppressed_floor": "drains withheld by the fleet.min_replicas "
+                        "floor (incl. floor-pinned in-place heals)",
+    "spawn_failures": "spawn/restart actions whose replica never "
+                      "came up",
+}
+
 # Per-phase histogram bases render as ONE family with a `phase` label
 # (gateway_backend_tick_phase_ms{target, phase}) so a dashboard can
 # overlay a tick's phases; everything else renders per-name.
@@ -498,6 +523,29 @@ class GatewayMetrics:
             registry=self.registry,
         )
         self._routing_policy_seen = None
+        # Fleet-supervisor counters + pool gauges (serving/fleet.py),
+        # set from the supervisor snapshot at scrape time. Absent (all
+        # zero) without a supervisor attached.
+        self.fleet_gauges = {
+            name: Gauge(
+                f"gateway_fleet_{name}",
+                f"Fleet supervisor: {help_text}",
+                registry=self.registry,
+            )
+            for name, help_text in _FLEET_HELP.items()
+        }
+        self.fleet_replicas = Gauge(
+            "gateway_fleet_replicas",
+            "Supervised replicas by state "
+            "(serving|retiring|healing|restarting)",
+            ["state"],
+            registry=self.registry,
+        )
+        self.fleet_paused = Gauge(
+            "gateway_fleet_paused",
+            "1 while the fleet supervisor is paused (POST /admin/fleet)",
+            registry=self.registry,
+        )
         # The overload early-warning gauge: admission-queue depth per
         # backend in both units (unit="requests" | "tokens") — watch
         # this against batching.max_pending / max_queue_tokens to see
@@ -639,6 +687,27 @@ class GatewayMetrics:
         for target, counters in routing.get("backends", {}).items():
             for name, gauge in self.routing_gauges.items():
                 self._child(gauge, target).set(float(counters.get(name, 0)))
+
+    def set_fleet_stats(self, snapshot: dict) -> None:
+        """Record the fleet supervisor snapshot
+        (FleetSupervisor.snapshot(): counters + per-replica states +
+        paused flag) as gateway_fleet_* series."""
+        if self.registry is None:
+            return
+        counters = snapshot.get("counters", {})
+        for name, gauge in self.fleet_gauges.items():
+            gauge.set(float(counters.get(name, 0)))
+        states: dict[str, int] = {}
+        for replica in snapshot.get("replicas", []):
+            state = replica.get("state", "serving")
+            states[state] = states.get(state, 0) + 1
+        for state in ("serving", "retiring", "healing", "restarting"):
+            self._child(self.fleet_replicas, state).set(
+                states.pop(state, 0)
+            )
+        for state, count in states.items():  # future-proof: unknown states
+            self._child(self.fleet_replicas, state).set(count)
+        self.fleet_paused.set(1 if snapshot.get("paused") else 0)
 
     def render(self) -> tuple[bytes, str]:
         """Prometheus text exposition."""

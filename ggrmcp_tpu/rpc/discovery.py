@@ -662,6 +662,54 @@ class ServiceDiscoverer:
         ):
             yield chunk
 
+    # -- elastic membership (the fleet supervisor's add/remove plane) --------
+
+    async def add_backend(self, target: str) -> Backend:
+        """Register + connect a NEW backend at runtime and rebuild the
+        tool registry so its methods join the replica pools — the
+        spawn half of the fleet supervisor's act plane
+        (serving/fleet.py). Idempotent per target: re-adding an
+        existing target just returns it. Connection failures propagate
+        (the caller owns the replica process and must know the spawn
+        did not take) after the backend is removed again — a backend
+        that never connected must not linger in the candidate set."""
+        for backend in self.backends:
+            if backend.target == target:
+                return backend
+        backend = Backend(f"backend{len(self.backends)}", target, self.cfg)
+        self.backends.append(backend)
+        try:
+            await backend.connect(self.cfg.connect_timeout_s)
+        except BaseException:
+            self.backends.remove(backend)
+            await backend.close()
+            raise
+        await self.discover_services()
+        logger.info("backend %s added at runtime", target)
+        return backend
+
+    async def remove_backend(self, target: str) -> None:
+        """Deregister a backend (by target or backendN name) and
+        rebuild the registry without it — the retire/kill half of the
+        fleet supervisor's act plane. Unknown targets are a no-op (the
+        replica may have died before it ever connected). In-flight
+        calls on the closed channel fail typed, exactly like a replica
+        dying under a call — the chaos suite's zero-silent-loss contract
+        covers both."""
+        backend = next(
+            (
+                b for b in self.backends
+                if target in (b.target, b.name)
+            ),
+            None,
+        )
+        if backend is None:
+            return
+        self.backends.remove(backend)
+        await backend.close()
+        await self.discover_services()
+        logger.info("backend %s removed at runtime", target)
+
     # -- drain (the operational primitive behind POST /admin/drain) ---------
 
     def set_draining(self, target: str, draining: bool) -> list[dict[str, Any]]:

@@ -19,6 +19,7 @@ import grpc.aio
 from google.protobuf import descriptor_pb2, descriptor_pool
 
 from ggrmcp_tpu.rpc.pb import health_pb2, reflection_pb2
+from ggrmcp_tpu.utils import failpoints
 
 logger = logging.getLogger("ggrmcp.rpc.server")
 
@@ -199,7 +200,21 @@ class HealthService:
     def set(self, service: str, status: int) -> None:
         self._status[service] = status
 
+    @staticmethod
+    def _flapped() -> bool:
+        """Chaos hook (utils/failpoints.py `health_flap`): a due
+        evaluation makes THIS probe answer NOT_SERVING — armed with
+        every=2 the probe alternates, the flap shape the fleet
+        supervisor's heal policy triggers on (serving/fleet.py)."""
+        try:
+            failpoints.evaluate("health_flap")
+        except failpoints.FailpointError:
+            return True
+        return False
+
     async def check(self, request: health_pb2.HealthCheckRequest, context):
+        if self._flapped():
+            return health_pb2.HealthCheckResponse(status=NOT_SERVING)
         status = self._status.get(request.service)
         if status is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")
@@ -213,6 +228,8 @@ class HealthService:
         yield health_pb2.HealthCheckResponse(status=status)
 
     def check_sync(self, request: health_pb2.HealthCheckRequest, context):
+        if self._flapped():
+            return health_pb2.HealthCheckResponse(status=NOT_SERVING)
         status = self._status.get(request.service)
         if status is None:
             context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")

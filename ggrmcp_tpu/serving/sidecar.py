@@ -344,8 +344,26 @@ class Sidecar:
                 f"constraint schema rejected: {exc}",
             )
 
+    @staticmethod
+    def _maybe_replica_crash() -> None:
+        """Chaos hook (utils/failpoints.py `replica_crash`): a due
+        evaluation aborts the WHOLE worker process — `every=N` is
+        "this replica dies after N calls", the process-level fault the
+        fleet supervisor's heal path must notice and restart
+        (serving/fleet.py; tests/test_fleet.py arms it through the
+        spawned worker's GGRMCP_FAILPOINTS env). os._exit, not
+        sys.exit: a crash must not unwind politely through grpc's
+        handlers — that politeness is exactly what a real SIGKILL
+        doesn't grant."""
+        try:
+            failpoints.evaluate("replica_crash")
+        except failpoints.FailpointError as exc:
+            logger.critical("replica_crash failpoint fired: %s", exc)
+            os._exit(86)
+
     async def generate(self, request: serving_pb2.GenerateRequest, context):
         assert self.generation is not None and self.batcher is not None
+        self._maybe_replica_crash()
         t0 = time.perf_counter()
         trace_id = tracing.trace_id_from_metadata(
             context.invocation_metadata()
@@ -465,6 +483,7 @@ class Sidecar:
 
     async def generate_stream(self, request: serving_pb2.GenerateRequest, context):
         assert self.generation is not None and self.batcher is not None
+        self._maybe_replica_crash()
         trace_id = tracing.trace_id_from_metadata(
             context.invocation_metadata()
         )

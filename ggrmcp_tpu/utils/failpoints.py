@@ -38,6 +38,19 @@ Hook sites (the names the serving plane evaluates):
                  skips it until the watchdog revives it) — the
                  replica-kill half of the drain/kill chaos suite
                  (tests/test_router.py)
+  replica_crash  Sidecar.generate/generate_stream — PROCESS-level:
+                 when due, the worker logs and aborts the whole
+                 process (os._exit(86)) — arm with every=N for "worker
+                 dies after N calls". The fleet supervisor's heal path
+                 (serving/fleet.py) is what notices and restarts it;
+                 this is the deterministic half of the SIGKILL chaos
+                 drills (tests/test_fleet.py)
+  health_flap    HealthService.check/check_sync — the gRPC health
+                 probe answers NOT_SERVING when due: every=2 makes the
+                 probe alternate healthy/unhealthy, the flap shape
+                 fleet.flap_threshold healing triggers on — real
+                 flapping at the probe surface, not just
+                 ConnectionErrors
 
 Evaluation is cheap when nothing is armed (one dict lookup) and
 deterministic given the call sequence: `every=N` fires on the Nth,

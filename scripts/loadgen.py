@@ -121,6 +121,12 @@ async def run(args: argparse.Namespace) -> dict:
 
     fixed_body = None if args.arguments_template else body_for(0, 0)
     latencies: list[float] = []
+    # --tolerate-errors accounting: sheds are the 429s bounded
+    # admission answers under overload (the fleet bench's scale-up
+    # signal — an overload trace MUST keep driving through them,
+    # which is exactly what a retrying client population does);
+    # errors are everything else non-200.
+    counters = {"sheds": 0, "errors": 0}
 
     async def one_call(
         proto: _ClientProtocol, record: bool, request: bytes
@@ -137,6 +143,32 @@ async def run(args: argparse.Namespace) -> dict:
             or b'"error"' in payload
             or b'"isError"' in payload
         ):
+            if args.tolerate_errors:
+                if head.startswith(b"HTTP/1.1 429"):
+                    counters["sheds"] += 1
+                    # Honor Retry-After like a real client: a shed
+                    # that costs the session nothing would melt an
+                    # overload trace into an instant 429 storm no
+                    # control loop (or server) could ever be measured
+                    # against.
+                    lower = head.lower()
+                    idx = lower.find(b"retry-after:")
+                    delay = 0.25
+                    if idx >= 0:
+                        eol = lower.find(b"\r\n", idx)
+                        try:
+                            delay = float(lower[idx + 12: eol].strip())
+                        except ValueError:
+                            pass
+                    await asyncio.sleep(min(delay, 2.0))
+                else:
+                    counters["errors"] += 1
+                    # Errors back off too: an un-throttled error storm
+                    # (e.g. a fleet with zero replicas up yet) would
+                    # monopolize the host and starve the very recovery
+                    # it is waiting for.
+                    await asyncio.sleep(0.25)
+                return head
             raise RuntimeError(
                 f"call failed ({head[:15]!r}): {payload[:200]!r}"
             )
@@ -159,15 +191,30 @@ async def run(args: argparse.Namespace) -> dict:
             if idx >= 0:
                 eol = lower.find(b"\r\n", idx)
                 sid = head[idx + 15: eol if eol >= 0 else len(head)].strip().decode()
-            if fixed_body is not None:
-                request = build_request(hostport, fixed_body, sid)
-                for _ in range(calls - 1):
+            # Fixed traffic keeps the precomputed request byte-string
+            # (the proxy bench's hot path); templated traffic builds
+            # per call.
+            fixed_request = (
+                build_request(hostport, fixed_body, sid)
+                if fixed_body is not None else None
+            )
+            for i in range(1, calls):
+                request = (
+                    fixed_request if fixed_request is not None
+                    else build_request(hostport, body_for(s, i), sid)
+                )
+                try:
                     await one_call(proto, record, request)
-            else:
-                for i in range(1, calls):
-                    await one_call(
-                        proto, record,
-                        build_request(hostport, body_for(s, i), sid),
+                except (ConnectionError, OSError):
+                    if not args.tolerate_errors:
+                        raise
+                    # The server (or a dying replica behind it) dropped
+                    # the connection: count it and dial a fresh one —
+                    # a tolerant client population outlives churn.
+                    counters["errors"] += 1
+                    transport.close()
+                    transport, proto = await loop.create_connection(
+                        _ClientProtocol, host, port
                     )
         finally:
             transport.close()
@@ -194,6 +241,8 @@ async def run(args: argparse.Namespace) -> dict:
         "end": end,
         "count": len(latencies),
         "latencies_ms": latencies,
+        "sheds": counters["sheds"],
+        "errors": counters["errors"],
     }
 
 
@@ -210,6 +259,12 @@ def main() -> None:
     parser.add_argument("--sessions", type=int, default=8)
     parser.add_argument("--calls-per-session", type=int, default=100)
     parser.add_argument("--warmup", type=int, default=4)
+    parser.add_argument(
+        "--tolerate-errors", action="store_true",
+        help="count non-200s (429 sheds separately) and keep driving "
+        "instead of failing the run — overload/chaos traces where "
+        "sheds are the measurement, not a bug",
+    )
     args = parser.parse_args()
     result = asyncio.run(run(args))
     print(json.dumps(result), flush=True)
