@@ -7,8 +7,8 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: proto proto-check descriptors test test-all test-fast test-chaos \
   test-obs test-grammar test-spec-batch test-paged test-tp test-analysis \
-  test-disagg test-fleet bench-cpu smoke e2e lint graftlint ci-local \
-  preflight clean
+  test-disagg test-fleet test-mem bench-cpu smoke e2e lint graftlint \
+  ci-local preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
 # No protoc on this image? scripts/regen_serving_pb2.py regenerates
@@ -141,6 +141,15 @@ test-disagg:
 # loop for serving/fleet.py work.
 test-fleet:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m fleet
+
+# Device-memory ledger + compile watcher net alone (CPU mesh): ledger
+# closure against JAX live-buffer totals across serving configs,
+# obs-off zero-work, steady-state recompile detection, /debug/memory +
+# /debug/profile on both http impls, the {component}-labeled memory
+# family on /metrics. Tier-1 runs these too; this target is the fast
+# inner loop for serving/memory_ledger.py + compile_watcher.py work.
+test-mem:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m mem
 
 # ruff if present (baked CI image installs it; the TPU image may not).
 lint:

@@ -545,6 +545,45 @@ async def _run_bench() -> dict:
             raise RuntimeError(f"warmup failed: {data['error']}")
         warmup_s = time.perf_counter() - t0
 
+        # Device-memory ledger + compile watcher probe (ISSUE 13,
+        # docs/observability.md): compile-count deltas per bench phase
+        # and the running per-component byte PEAK, sampled at phase
+        # boundaries — device shapes only change on alloc/rebuild
+        # events, so boundary sampling sees every plateau. All zero
+        # under GGRMCP_BENCH_OBS=off (the overhead A/B).
+        from ggrmcp_tpu.serving.compile_watcher import (
+            watcher as _compile_watcher,
+        )
+
+        obs_phase_compiles: dict = {}
+        obs_mem_peak: dict = {}
+        _obs_last = {"count": 0}
+
+        def obs_mark(phase: str) -> None:
+            try:
+                now = _compile_watcher.stats()["compile_count"]
+                obs_phase_compiles[phase] = (
+                    obs_phase_compiles.get(phase, 0)
+                    + now - _obs_last["count"]
+                )
+                _obs_last["count"] = now
+                if sidecar.generation is not None:
+                    ledger_bytes = sidecar.generation.ledger.base_bytes()
+                    for comp, b in ledger_bytes.items():
+                        obs_mem_peak[comp] = max(
+                            obs_mem_peak.get(comp, 0), int(b)
+                        )
+            except Exception as exc:  # diagnostics must not sink the run
+                print(f"bench: obs probe failed: {exc!r}", file=sys.stderr)
+
+        # Everything up to here — engine init + warmup ladders + the
+        # first call's stragglers — is the expected cold-compile bill;
+        # re-draw the warm line so compiles_post_warmup counts only
+        # compiles that landed under MEASURED load (the steady-state
+        # recompile signal the preflight checks).
+        obs_mark("warmup")
+        _compile_watcher.mark_warm()
+
         calls_per_session = max(1, total_calls // sessions)
 
         # The measured load comes from scripts/loadgen.py in a SEPARATE
@@ -664,6 +703,7 @@ async def _run_bench() -> dict:
             _STASHED["line"] = json.dumps(headline)
         if not _claim_output():
             raise RuntimeError("watchdog claimed output before run completed")
+        obs_mark("headline")
 
         # Knob-tuning runs (e.g. a TICK_STEPS sweep in a live tunnel
         # window) only need the headline number; the secondary phases
@@ -812,6 +852,7 @@ async def _run_bench() -> dict:
             pass
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: prefix phase failed: {exc!r}", file=sys.stderr)
+        obs_mark("prefix")
 
         # Long-prompt phase: prompts past FLASH_MIN_SEQ so a TPU run
         # exercises the Pallas flash kernel in situ — the headline
@@ -907,6 +948,7 @@ async def _run_bench() -> dict:
             pass
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: long-prompt phase failed: {exc!r}", file=sys.stderr)
+        obs_mark("long")
 
         # Mixed-workload phase: long-prompt admissions landing WHILE
         # other requests in the same tier are mid-decode — the
@@ -1057,6 +1099,7 @@ async def _run_bench() -> dict:
             pass
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: mixed phase failed: {exc!r}", file=sys.stderr)
+        obs_mark("mixed")
 
         # Grammar-constrained decode A/B (GGRMCP_BENCH_GRAMMAR=on|off,
         # docs/structured_output.md): the same calls with and without a
@@ -1161,6 +1204,7 @@ async def _run_bench() -> dict:
             pass
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: grammar phase failed: {exc!r}", file=sys.stderr)
+        obs_mark("grammar")
 
     # Per-tick timing breakdown (round-4 verdict #1c: show where the
     # milliseconds live — host dispatch vs device compute/transfer vs
@@ -1242,6 +1286,29 @@ async def _run_bench() -> dict:
     except Exception:
         pass  # CPU backend has no memory_stats
 
+    # Ledger + compile-watcher export (ISSUE 13): peak bytes per named
+    # component over the run, compile-count deltas per phase, and the
+    # steady-state recompile verdict — compiles_post_warmup > 0 at
+    # serving time is the silent perf killer the watcher exists for
+    # (docs/observability.md "TPU-window preflight").
+    obs_export = {}
+    try:
+        obs_mark("teardown")
+        cst = _compile_watcher.stats()
+        obs_export = {
+            "memory_peak_bytes": {
+                k: int(v) for k, v in sorted(obs_mem_peak.items())
+            },
+            "compiles_total": cst["compile_count"],
+            "compile_ms_total": round(cst["compile_ms"], 1),
+            "compile_cache_hits": cst["compile_cache_hits"],
+            "compile_cache_misses": cst["compile_cache_misses"],
+            "compiles_post_warmup": cst["compile_post_warmup"],
+            "compiles_per_phase": dict(obs_phase_compiles),
+        }
+    except Exception as exc:  # diagnostics must not sink the result
+        print(f"bench: obs export failed: {exc!r}", file=sys.stderr)
+
     await gateway.stop()
     await sidecar.stop()
 
@@ -1307,8 +1374,8 @@ async def _run_bench() -> dict:
         except Exception as exc:  # secondary metric must not sink the run
             print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
     return {
-        **headline, **hbm, **prefix, **longp, **mixed, **grammar,
-        **ticktime, **specbatch, **paged, **tp, **proxy,
+        **headline, **hbm, **obs_export, **prefix, **longp, **mixed,
+        **grammar, **ticktime, **specbatch, **paged, **tp, **proxy,
     }
 
 

@@ -294,6 +294,135 @@ class AllocInJitRule(Rule):
 
 
 # ---------------------------------------------------------------------
+# 3b. ledger-unregistered — the memory ledger's coverage invariant
+# ---------------------------------------------------------------------
+
+
+class LedgerUnregisteredRule(Rule):
+    """Persistent device allocations in serving modules must register
+    a component with the memory ledger: an attribute assigned from a
+    cache/params factory that no ledger.register() supplier reads is
+    HBM the ledger cannot see — the exact drift the closure test
+    (reconcile against jax.live_arrays) exists to catch, surfaced at
+    lint time instead of as unattributed bytes in a TPU window."""
+
+    id = "ledger-unregistered"
+    title = (
+        "persistent device allocation not registered with the memory "
+        "ledger"
+    )
+    precedent = (
+        "ISSUE 13 (docs/observability.md): before the ledger, the tree "
+        "exported exactly one memory number (kv_cache_bytes) while "
+        "weights, the paged arena, draft caches, grammar tables, and "
+        "block tables were unaccounted — one bad allocation from OOM "
+        "in the llama3-8b window with nothing naming the bytes. "
+        "serving/memory_ledger.py::MemoryLedger.reconcile is the "
+        "runtime closure; this rule is its static complement."
+    )
+
+    # Calls whose result is a persistent device allocation when stored
+    # on self: the engine's cache/params factories, the batcher's
+    # mini/shared-cache builders, replicated host→device snapshots,
+    # and jax/jnp zeros-family factories. np is HOST memory — exempt;
+    # asarray/array transfers are the unsharded-transfer rule's
+    # territory (usually transient jit inputs, its documented carve-out).
+    _ALLOC_TAILS = {
+        "make_cache", "make_paged_cache", "make_draft_cache",
+        "_make_mini", "_make_shared_cache", "_snap_dev", "device_put",
+        "_sharded_init", "_shard_params", "_synthetic_int8_init",
+    }
+    _FACTORY_TAILS = {
+        "zeros", "ones", "empty", "full", "zeros_like", "ones_like",
+        "empty_like", "full_like",
+    }
+    _FACTORY_ROOTS = {"jnp", "jax"}
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith("ggrmcp_tpu/serving/")
+
+    def _is_alloc(self, node) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        parts = call_name(node).split(".")
+        if parts[-1] in self._ALLOC_TAILS:
+            return True
+        return (
+            parts[-1] in self._FACTORY_TAILS
+            and parts[0] in self._FACTORY_ROOTS
+        )
+
+    @staticmethod
+    def _attrs_in(node) -> set:
+        """Every `self.<x>`-style attribute name under `node`."""
+        return {
+            n.attr for n in ast.walk(node) if isinstance(n, ast.Attribute)
+        }
+
+    def _registered_attrs(self, cls: ast.ClassDef) -> set:
+        """Attribute names any ledger.register() supplier reads —
+        directly (lambda args) or one method-reference hop away
+        (`register("weights", self._ledger_weights)` scans that
+        method's body)."""
+        methods = {
+            n.name: n for n in ast.walk(cls)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        out: set = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            parts = call_name(node).split(".")
+            if parts[-1] != "register" or "ledger" not in parts:
+                continue
+            for arg in [*node.args, *(kw.value for kw in node.keywords)]:
+                out |= self._attrs_in(arg)
+                # One indirection: a self.<method> / bare-name supplier
+                # defined in this class contributes its body's attrs.
+                names = self._attrs_in(arg) | {
+                    n.id for n in ast.walk(arg)
+                    if isinstance(n, ast.Name)
+                }
+                for name in names & set(methods):
+                    out |= self._attrs_in(methods[name])
+        return out
+
+    def check(self, module: Module):
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            registered = self._registered_attrs(cls)
+            flagged: set = set()
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                targets = [
+                    t for t in node.targets
+                    if isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ]
+                if not targets:
+                    continue
+                if not any(
+                    self._is_alloc(n) for n in ast.walk(node.value)
+                ):
+                    continue
+                for t in targets:
+                    if t.attr in registered or t.attr in flagged:
+                        continue
+                    flagged.add(t.attr)
+                    yield self.finding(
+                        module.rel, node.lineno,
+                        f"self.{t.attr} holds a persistent device "
+                        "allocation but no ledger.register() supplier "
+                        "reads it — register a component "
+                        "(engine.ledger.register(name, lambda: "
+                        f"self.{t.attr})) so reconcile() can close",
+                    )
+
+
+# ---------------------------------------------------------------------
 # 4. async-hygiene — PR 2's swallowed CancelledError
 # ---------------------------------------------------------------------
 
@@ -547,6 +676,7 @@ ALL_RULES = (
     ShardedSamplingRule(),
     UnshardedTransferRule(),
     AllocInJitRule(),
+    LedgerUnregisteredRule(),
     AsyncHygieneRule(),
     ProtoDriftRule(),
 )

@@ -97,6 +97,10 @@ class Gateway:
         app.router.add_get(
             "/debug/timeline", self.handler.handle_debug_timeline
         )
+        app.router.add_get("/debug/memory", self.handler.handle_debug_memory)
+        app.router.add_post(
+            "/debug/profile", self.handler.handle_debug_profile
+        )
         app.router.add_post("/admin/drain", self.handler.handle_admin_drain)
         app.router.add_post(
             "/admin/undrain", self.handler.handle_admin_undrain

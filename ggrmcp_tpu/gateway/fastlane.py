@@ -14,8 +14,8 @@ precomputed-header `bytes` + body per call.
 
 Served surface is identical to the aiohttp app (gateway/app.py routes):
 GET/POST/OPTIONS /, /health, /metrics, /stats, /debug/traces,
-/debug/ticks, /debug/requests, /debug/timeline, SSE streaming on
-tools/call.
+/debug/ticks, /debug/requests, /debug/timeline, /debug/memory,
+POST /debug/profile, SSE streaming on tools/call.
 `server.http_impl` selects the implementation;
 both are driven by the same test suite (tests/test_fastlane.py runs the
 gateway protocol tests against this server).
@@ -609,6 +609,19 @@ class FastLaneServer:
             )
             self._write_json(conn, headers, status, body_dict)
             return status
+        if path == "/debug/profile":
+            # POST: a capture is an action (it spends a device window),
+            # not a read — same verb on both http impls.
+            if method != "POST":
+                self._write_response(conn, headers, 405, None, b"")
+                return 405
+            query = parse_qs(urlsplit(target).query)
+            body_dict = await h.debug_profile_body(
+                query.get("duration_ms", ["1000"])[0],
+                query.get("label", [""])[0],
+            )
+            self._write_json(conn, headers, 200, body_dict)
+            return 200
         if method != "GET":
             self._write_response(conn, headers, 405, None, b"")
             return 405
@@ -643,6 +656,13 @@ class FastLaneServer:
         if path == "/debug/timeline":
             query = parse_qs(urlsplit(target).query)
             body = await h.timeline_body(query.get("n", ["512"])[0])
+            self._write_json(conn, headers, 200, body)
+            return 200
+        if path == "/debug/memory":
+            query = parse_qs(urlsplit(target).query)
+            body = await h.debug_memory_body(
+                query.get("reconcile", ["1"])[0]
+            )
             self._write_json(conn, headers, 200, body)
             return 200
         self._write_response(conn, headers, 404, None, b"")

@@ -857,6 +857,55 @@ class MCPHandler:
             await self.timeline_body(request.query.get("n", "512"))
         )
 
+    async def debug_memory_body(self, reconcile_raw: str) -> dict[str, Any]:
+        """GET /debug/memory core: the device-memory ledger fan-out
+        (DebugService.GetMemory) — per-backend component bytes, the
+        closure reconciliation against JAX live-buffer totals
+        (?reconcile=0 skips the live-array census), and the compile
+        watcher's counters + recent-compile ring. The byte complement
+        of /debug/ticks' time attribution; framework-free, shared by
+        both HTTP impls (docs/observability.md)."""
+        reconcile = reconcile_raw not in ("0", "false", "off")
+        entries = await self.discoverer.get_backend_memory(
+            reconcile=reconcile
+        )
+        return {"reconcile": reconcile, "backends": entries}
+
+    async def handle_debug_memory(
+        self, request: web.Request
+    ) -> web.Response:
+        return web.json_response(await self.debug_memory_body(
+            request.query.get("reconcile", "1")
+        ))
+
+    async def debug_profile_body(
+        self, duration_raw: str, label: str
+    ) -> dict[str, Any]:
+        """POST /debug/profile core: fan the sidecar DebugService
+        profiler capture out to every backend and return the
+        per-backend server-side artifact paths — the "minimal capture
+        FIRST" TPU-window preflight as one gateway command
+        (docs/observability.md). ?duration_ms= bounds the window
+        (sidecar clamps to [10, 60000]); ?label= names the dump
+        (sanitized server-side, never a path)."""
+        try:
+            duration_ms = int(duration_raw)
+        except ValueError:
+            duration_ms = 1000
+        entries = await self.discoverer.profile_backends(
+            duration_ms=duration_ms, label=label
+        )
+        return {"durationMs": duration_ms, "backends": entries}
+
+    async def handle_debug_profile(
+        self, request: web.Request
+    ) -> web.Response:
+        body = await self.debug_profile_body(
+            request.query.get("duration_ms", "1000"),
+            request.query.get("label", ""),
+        )
+        return web.json_response(body)
+
     # ------------------------------------------------------------------
     # helpers
     # ------------------------------------------------------------------

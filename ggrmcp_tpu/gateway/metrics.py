@@ -28,7 +28,10 @@ try:
         Histogram,
         generate_latest,
     )
-    from prometheus_client.core import HistogramMetricFamily
+    from prometheus_client.core import (
+        GaugeMetricFamily,
+        HistogramMetricFamily,
+    )
 
     HAVE_PROMETHEUS = True
 except Exception:  # pragma: no cover - baked into the image, but be safe
@@ -137,6 +140,38 @@ _SERVING_HELP = {
         "KV pages imported from peer sidecars",
     "kv_transfer_bytes_sent": "KV transfer wire bytes sent",
     "kv_transfer_bytes_received": "KV transfer wire bytes received",
+    # Device-memory ledger (serving/memory_ledger.py): per-component
+    # bytes derived from the live arrays. These render as ONE labeled
+    # family — gateway_backend_memory_bytes{target, component} — via
+    # the memory collector, not as per-field gauges; the help entries
+    # here keep the proto-drift contract (every scalar field named).
+    "memory_weights_bytes":
+        "ledger: target + draft model parameter bytes (LoRA excluded)",
+    "memory_lora_bytes": "ledger: stacked LoRA adapter factor bytes",
+    "memory_kv_arena_bytes":
+        "ledger: shared KV slot pool / paged page arena bytes",
+    "memory_block_tables_bytes":
+        "ledger: paged per-slot device block-table bytes",
+    "memory_draft_cache_bytes":
+        "ledger: speculative draft slot-pool KV bytes",
+    "memory_prefix_pool_bytes":
+        "ledger: slot-granular prefix-pool KV bytes (paged off)",
+    "memory_ilv_mini_bytes":
+        "ledger: interleaved-admission mini-cache bytes",
+    "memory_grammar_arena_bytes":
+        "ledger: device grammar DFA allow/transition table bytes",
+    "memory_tick_state_bytes":
+        "ledger: per-slot device twins (cur/prev tokens, grammar "
+        "states)",
+    # Compile watcher (serving/compile_watcher.py): XLA compiles in the
+    # sidecar process — the silent perf killer as counters.
+    "compile_count": "XLA compiles observed since process start",
+    "compile_ms": "cumulative XLA compile wall time (ms)",
+    "compile_cache_hits": "persistent compile-cache hits",
+    "compile_cache_misses": "persistent compile-cache misses",
+    "compile_post_warmup":
+        "steady-state recompiles after the warmup mark (must stop "
+        "growing once first traffic settles)",
 }
 
 _SERVING_HIST_HELP = {
@@ -149,6 +184,9 @@ _SERVING_HIST_HELP = {
     "tick_phase_dispatch_ms": "per-tick jitted-dispatch time (ms)",
     "tick_phase_wait_ms": "per-tick device-wait time (ms)",
     "tick_phase_host_ms": "per-tick host-postprocess time (ms)",
+    "tpot_ms":
+        "per-request mean inter-token latency (TPOT, ms) — the "
+        "streaming-smoothness complement of TTFT",
 }
 
 # Replica-routing counter help (rpc/router.py COUNTER_NAMES): the
@@ -206,6 +244,15 @@ _FLEET_HELP = {
 # (gateway_backend_tick_phase_ms{target, phase}) so a dashboard can
 # overlay a tick's phases; everything else renders per-name.
 _PHASE_HIST_PREFIX = "tick_phase_"
+
+# Memory-ledger fields (`memory_<component>_bytes`) render as ONE
+# family with a `component` label — gateway_backend_memory_bytes
+# {target, component} — so a dashboard stacks a replica's HBM
+# partition on one chart and `sum by (target)` is the total. They are
+# EXCLUDED from the per-field gauge set (serving_gauge_names), exactly
+# like the phase histograms are excluded from per-name render.
+_MEMORY_FIELD_RE = "memory_"
+_MEMORY_FIELD_SUFFIX = "_bytes"
 
 # /debug/ticks field help, keyed by TickRecord proto field name. Every
 # scalar numeric TickRecord field must be named here — graftlint's
@@ -273,20 +320,41 @@ def serving_histogram_names() -> list[str]:
     ]
 
 
+def serving_memory_component_names() -> list[str]:
+    """Ledger component names derived from the descriptor: every
+    scalar `memory_<component>_bytes` field declares one — rendered as
+    the component label of the gateway_backend_memory_bytes family."""
+    desc = serving_pb2.ServingStatsResponse.DESCRIPTOR
+    return [
+        f.name[len(_MEMORY_FIELD_RE):-len(_MEMORY_FIELD_SUFFIX)]
+        for f in desc.fields
+        if not _is_repeated(f)
+        and f.name.startswith(_MEMORY_FIELD_RE)
+        and f.name.endswith(_MEMORY_FIELD_SUFFIX)
+    ]
+
+
 def serving_gauge_names() -> list[str]:
     """Gauge names derived from the descriptor: every NUMERIC scalar
     (non-repeated) field that is not part of a histogram triplet.
     String fields (mesh_shape) carry identity, not magnitude — they
-    export as labels on the info series instead (serving_info_names)."""
+    export as labels on the info series instead (serving_info_names);
+    memory-ledger fields export through the component-labeled family
+    (serving_memory_component_names), not as per-field gauges."""
     desc = serving_pb2.ServingStatsResponse.DESCRIPTOR
     hist_members = set()
     for base in serving_histogram_names():
         hist_members.update((f"{base}_sum", f"{base}_count"))
+    memory_fields = {
+        f"{_MEMORY_FIELD_RE}{name}{_MEMORY_FIELD_SUFFIX}"
+        for name in serving_memory_component_names()
+    }
     return [
         f.name
         for f in desc.fields
         if not _is_repeated(f)
         and f.name not in hist_members
+        and f.name not in memory_fields
         and f.cpp_type != f.CPPTYPE_STRING
     ]
 
@@ -411,6 +479,46 @@ class _ServingHistogramCollector:
         self.snap.pop(target, None)
 
 
+class _ServingMemoryCollector:
+    """Renders the backends' memory-ledger snapshot as ONE labeled
+    family — gateway_backend_memory_bytes{target, component} — from
+    the scalar memory_<component>_bytes ServingStats fields. A custom
+    collector (like the histogram one) because the component set is a
+    label dimension, not a metric-name dimension: `sum by (target)` is
+    the replica's total accounted HBM, and a stacked-area panel of the
+    components is the byte twin of the tick-phase chart."""
+
+    def __init__(self) -> None:
+        # target -> component -> bytes
+        self.snap: dict[str, dict[str, float]] = {}
+
+    def collect(self):
+        family = GaugeMetricFamily(
+            "gateway_backend_memory_bytes",
+            "Backend ServingStats: device-memory ledger bytes per "
+            "component (serving/memory_ledger.py — all zero when "
+            "observability is off)",
+            labels=["target", "component"],
+        )
+        for target in sorted(self.snap):
+            for component, value in sorted(self.snap[target].items()):
+                family.add_metric([target, component], value)
+        yield family
+
+    def update(self, target: str, per_backend_entry: dict) -> None:
+        self.snap[target] = {
+            name: float(per_backend_entry.get(
+                _snake_to_camel(
+                    f"{_MEMORY_FIELD_RE}{name}{_MEMORY_FIELD_SUFFIX}"
+                ), 0
+            ))
+            for name in serving_memory_component_names()
+        }
+
+    def remove(self, target: str) -> None:
+        self.snap.pop(target, None)
+
+
 class GatewayMetrics:
     """All gateway-side instruments, on a private registry."""
 
@@ -500,6 +608,10 @@ class GatewayMetrics:
         # can aggregate across backends and compute window quantiles.
         self.serving_histograms = _ServingHistogramCollector()
         self.registry.register(self.serving_histograms)
+        # Device-memory ledger family: {target, component}-labeled
+        # bytes, the HBM partition beside the time partition above.
+        self.serving_memory = _ServingMemoryCollector()
+        self.registry.register(self.serving_memory)
         # Replica-routing placement counters (rpc/router.py), set from
         # the discoverer's snapshot at scrape time like the serving
         # gauges above. Gauges rather than Counters because the
@@ -638,6 +750,7 @@ class GatewayMetrics:
             self._mesh_info_labels[target] = info
             self.serving_mesh_info.labels(target, *info).set(1)
             self.serving_histograms.update(target, entry)
+            self.serving_memory.update(target, entry)
             for unit, key in (("requests", "queuedRequests"),
                               ("tokens", "queuedTokens")):
                 self._child(
@@ -651,6 +764,7 @@ class GatewayMetrics:
                     pass
                 self._children.pop((id(gauge), target), None)
             self.serving_histograms.remove(target)
+            self.serving_memory.remove(target)
             prev = self._mesh_info_labels.pop(target, None)
             if prev is not None:
                 try:

@@ -749,26 +749,20 @@ class ServiceDiscoverer:
 
     SERVING_STATS_METHOD = "ggrmcp.tpu.ModelInfoService.GetServingStats"
     FLIGHT_RECORD_METHOD = "ggrmcp.tpu.DebugService.GetFlightRecord"
+    MEMORY_METHOD = "ggrmcp.tpu.DebugService.GetMemory"
+    PROFILE_METHOD = "ggrmcp.tpu.DebugService.Profile"
 
-    async def get_backend_flight_records(
+    async def _fanout_diagnostics(
         self,
-        trace_id: str = "",
-        max_ticks: int = 0,
-        max_requests: int = 0,
-        timeout_s: float = 2.0,
+        method_full_name: str,
+        arguments: dict[str, Any],
+        timeout_s: float,
     ) -> list[dict[str, Any]]:
-        """Flight-recorder rings from every healthy backend exposing
-        DebugService.GetFlightRecord (TPU sidecars), one protojson
-        entry per backend — the /debug/ticks and /debug/requests body.
-        Same failure contract as get_backend_serving_stats: a slow or
-        failed backend contributes an error entry, never an exception."""
-        arguments: dict[str, Any] = {}
-        if trace_id:
-            arguments["traceId"] = trace_id
-        if max_ticks:
-            arguments["maxTicks"] = int(max_ticks)
-        if max_requests:
-            arguments["maxRequests"] = int(max_requests)
+        """Call a diagnostic RPC on every healthy backend that exposes
+        it (TPU sidecars; other backends just don't have the method),
+        one protojson entry per backend. Concurrent; a slow or failed
+        backend contributes an {"target", "error"} entry, never an
+        exception — a wedged sidecar must not fail the whole surface."""
 
         async def call(backend: Backend, mi) -> dict[str, Any]:
             try:
@@ -788,45 +782,78 @@ class ServiceDiscoverer:
             mi = next(
                 (
                     m for m in backend.methods
-                    if m.full_name == self.FLIGHT_RECORD_METHOD
+                    if m.full_name == method_full_name
                 ),
                 None,
             )
             if mi is not None:
                 jobs.append(call(backend, mi))
         return list(await asyncio.gather(*jobs)) if jobs else []
+
+    async def get_backend_flight_records(
+        self,
+        trace_id: str = "",
+        max_ticks: int = 0,
+        max_requests: int = 0,
+        timeout_s: float = 2.0,
+    ) -> list[dict[str, Any]]:
+        """Flight-recorder rings from every healthy backend exposing
+        DebugService.GetFlightRecord (TPU sidecars), one protojson
+        entry per backend — the /debug/ticks and /debug/requests body."""
+        arguments: dict[str, Any] = {}
+        if trace_id:
+            arguments["traceId"] = trace_id
+        if max_ticks:
+            arguments["maxTicks"] = int(max_ticks)
+        if max_requests:
+            arguments["maxRequests"] = int(max_requests)
+        return await self._fanout_diagnostics(
+            self.FLIGHT_RECORD_METHOD, arguments, timeout_s
+        )
 
     async def get_backend_serving_stats(
         self, timeout_s: float = 2.0
     ) -> list[dict[str, Any]]:
         """Best-effort ServingStats from every healthy backend exposing
-        the model plane's stats RPC (TPU sidecars; other backends just
-        don't have the method). Fans out concurrently; a slow or failed
-        backend contributes an error entry, never an exception."""
+        the model plane's stats RPC."""
+        return await self._fanout_diagnostics(
+            self.SERVING_STATS_METHOD, {}, timeout_s
+        )
 
-        async def call(backend: Backend, mi) -> dict[str, Any]:
-            try:
-                out = await backend.invoker.invoke(mi, {}, None, timeout_s)
-                return {"target": backend.target, **out}
-            except asyncio.CancelledError:
-                raise  # the gather owns cancellation, not the entry
-            except Exception as exc:  # noqa: BLE001 — diagnostics only
-                return {"target": backend.target, "error": str(exc)}
+    async def get_backend_memory(
+        self, reconcile: bool = True, timeout_s: float = 5.0
+    ) -> list[dict[str, Any]]:
+        """Device-memory ledger detail from every healthy backend
+        exposing DebugService.GetMemory — the GET /debug/memory body
+        (per-(scope, component) bytes, closure reconciliation against
+        JAX live-buffer totals, compile watcher counters + ring)."""
+        arguments: dict[str, Any] = (
+            {"reconcile": True} if reconcile else {}
+        )
+        return await self._fanout_diagnostics(
+            self.MEMORY_METHOD, arguments, timeout_s
+        )
 
-        jobs = []
-        for backend in self.backends:
-            if not backend.healthy or backend.invoker is None:
-                continue
-            mi = next(
-                (
-                    m for m in backend.methods
-                    if m.full_name == self.SERVING_STATS_METHOD
-                ),
-                None,
-            )
-            if mi is not None:
-                jobs.append(call(backend, mi))
-        return list(await asyncio.gather(*jobs)) if jobs else []
+    async def profile_backends(
+        self,
+        duration_ms: int = 1000,
+        label: str = "",
+        timeout_s: float = 90.0,
+    ) -> list[dict[str, Any]]:
+        """Fan the sidecar DebugService.Profile capture out to every
+        healthy backend — the POST /debug/profile body (per-backend
+        server-side artifact paths). The timeout covers the capture
+        window itself (the RPC blocks for duration_ms), with headroom
+        for profiler start/stop."""
+        arguments: dict[str, Any] = {}
+        if duration_ms:
+            arguments["durationMs"] = int(duration_ms)
+        if label:
+            arguments["outputDir"] = label
+        return await self._fanout_diagnostics(
+            self.PROFILE_METHOD, arguments,
+            max(timeout_s, duration_ms / 1000.0 + 30.0),
+        )
 
     def _stats_view(self) -> tuple[list[dict[str, Any]], float]:
         """The router's read-only view of the ServingStats snapshot:

@@ -232,6 +232,11 @@ class ContinuousBatcher:
     MAX_STAT_KEYS = (
         "admit_ms_max", "tp_chips", "mesh_devices", "mesh_shape",
         "mesh_spec_downgrades",
+        # Engine-level memory-ledger components: every tier reads the
+        # same process-wide weight/LoRA arrays — max of identical
+        # values reports them once instead of summing a constant per
+        # tier (the per-tier components below them sum as usual).
+        "memory_weights_bytes", "memory_lora_bytes",
     )
 
     def __init__(
@@ -239,6 +244,7 @@ class ContinuousBatcher:
         engine,  # GenerationEngine
         cfg: Optional[BatchingConfig] = None,
         eos_id: int = 2,
+        ledger_scope: str = "",
     ):
         self.engine = engine
         self.cfg = cfg or BatchingConfig()
@@ -625,6 +631,43 @@ class ContinuousBatcher:
             self._spec_admit = jax.jit(
                 self._spec_admit_impl, donate_argnums=(3,)
             )
+        # Device-memory ledger (serving/memory_ledger.py,
+        # docs/observability.md): every persistent device allocation
+        # this batcher owns registers a named component on the ENGINE's
+        # ledger, scoped per tier, with suppliers reading the live
+        # attributes — tick-failure rebuilds reassign self.cache etc.
+        # and the next read sees the new arrays. The graftlint rule
+        # `ledger-unregistered` holds future allocations to this.
+        self._ledger_scope = ledger_scope
+        engine.ledger.register(
+            "kv_arena",
+            lambda: (self.cache.k, self.cache.v, self.cache.length),
+            scope=ledger_scope,
+        )
+        engine.ledger.register(
+            "block_tables",
+            lambda: getattr(self.cache, "table", None),
+            scope=ledger_scope,
+        )
+        engine.ledger.register(
+            "draft_cache", lambda: self.dcache, scope=ledger_scope
+        )
+        engine.ledger.register(
+            "prefix_pool", lambda: self._pfx_pool, scope=ledger_scope
+        )
+        engine.ledger.register(
+            "ilv_mini", lambda: self._ilv_mini, scope=ledger_scope
+        )
+        engine.ledger.register(
+            "grammar_arena",
+            lambda: (self._g_allow_dev, self._g_trans_dev),
+            scope=ledger_scope,
+        )
+        engine.ledger.register(
+            "tick_state",
+            lambda: (self._cur_dev, self._prev_dev, self._gstate_dev),
+            scope=ledger_scope,
+        )
 
     def _make_mini(self, rows: int, length: int):
         """Admission mini cache matching the engine's KV storage."""
@@ -1722,6 +1765,12 @@ class ContinuousBatcher:
             jnp.asarray(np.zeros((b,), np.int32)),
             jnp.asarray(zgb), g_allow, g_trans,
         )
+        # Token/grammar-state feedback rides the tick as the COMMITTED
+        # device twin (_snap_dev) at real dispatch — warmup must
+        # compile against the same placement, or the warmed tick
+        # program is a variant serving never calls and the FIRST live
+        # request pays the real compile (the compile watcher caught
+        # exactly this: a post-warmup jit(_tick_impl) on call one).
         if self._spec:
             # Spec mode never runs the plain tick — warm the draft/
             # verify round and the draft-admission prefill (trickle and
@@ -1732,12 +1781,12 @@ class ContinuousBatcher:
                 _, _, self.cache, self.dcache, _, _, _
             ) = self._tick_spec(
                 self.engine.params, self.engine.draft_params,
-                jnp.asarray(self.prev_tokens),
-                jnp.asarray(self.cur_tokens), self.cache, self.dcache,
+                self._snap_dev(self.prev_tokens),
+                self._snap_dev(self.cur_tokens), self.cache, self.dcache,
                 jnp.asarray(self.seeds), jnp.int32(0),
                 jnp.asarray(self.temps), jnp.asarray(self.top_ks),
                 jnp.asarray(self.top_ps),
-                jnp.asarray(self.gstates), g_allow, g_trans,
+                self._snap_dev(self.gstates), g_allow, g_trans,
             )
             for r_rows in (1, b) if b > 1 else (1,):
                 self.dcache = self._spec_admit(
@@ -1749,14 +1798,14 @@ class ContinuousBatcher:
                 )
         else:
             _, self.cache, _ = self._tick(
-                self.engine.params, jnp.asarray(self.cur_tokens),
+                self.engine.params, self._snap_dev(self.cur_tokens),
                 self.cache,
                 jnp.asarray(self.seeds), jnp.int32(0),
                 jnp.asarray(self.temps), jnp.asarray(self.top_ks),
                 jnp.asarray(self.top_ps),
                 jnp.asarray(np.zeros((b,), bool)),
                 jnp.asarray(np.zeros((b,), np.int32)),
-                jnp.asarray(self.gstates), g_allow, g_trans,
+                self._snap_dev(self.gstates), g_allow, g_trans,
             )
         # Fused chunked-admission programs. The long-prompt grid
         # ([B, T, C]) compiles per distinct T — warm the single-chunk
@@ -1819,13 +1868,13 @@ class ContinuousBatcher:
                     self._ilv_mini, sel,
                 ) = self._tick_spec_chunk(
                     self.engine.params, self.engine.draft_params,
-                    jnp.asarray(self.prev_tokens),
-                    jnp.asarray(self.cur_tokens),
+                    self._snap_dev(self.prev_tokens),
+                    self._snap_dev(self.cur_tokens),
                     self.cache, self.dcache,
                     jnp.asarray(self.seeds), jnp.int32(0),
                     jnp.asarray(self.temps), jnp.asarray(self.top_ks),
                     jnp.asarray(self.top_ps),
-                    jnp.asarray(self.gstates), g_allow, g_trans,
+                    self._snap_dev(self.gstates), g_allow, g_trans,
                     jnp.asarray(np.zeros((k_rows, c), np.int32)),
                     self._ilv_mini,
                     jnp.asarray(np.zeros((k_rows,), np.int32)),
@@ -1835,7 +1884,7 @@ class ContinuousBatcher:
                 )
             else:
                 _, self.cache, self._ilv_mini, sel, _ = self._tick_chunk(
-                    self.engine.params, jnp.asarray(self.cur_tokens),
+                    self.engine.params, self._snap_dev(self.cur_tokens),
                     self.cache, jnp.asarray(self.seeds), jnp.int32(0),
                     jnp.asarray(self.temps), jnp.asarray(self.top_ks),
                     jnp.asarray(self.top_ps),
@@ -1847,7 +1896,7 @@ class ContinuousBatcher:
                     jnp.asarray(np.ones((k_rows,), np.int32)),
                     jnp.asarray(np.zeros((k_rows,), bool)),
                     jnp.asarray(np.zeros((k_rows,), np.int32)),
-                    jnp.asarray(self.gstates), g_allow, g_trans,
+                    self._snap_dev(self.gstates), g_allow, g_trans,
                 )
             _, self.cache = self._ilv_finish(
                 self.cache, self._ilv_mini, jnp.int32(0), jnp.int32(0),
@@ -2202,6 +2251,42 @@ class ContinuousBatcher:
         sidecar's span-attribution lookup)."""
         return self.recorder.request_record(trace_id)
 
+    # The ledger components this batcher reports as ServingStats
+    # memory_*_bytes scalars: engine-level (scope "", MAX-aggregated
+    # across tiers) then per-tier (summed). Mirrors the proto field
+    # set; the gateway renders them as ONE
+    # gateway_backend_memory_bytes{target, component} family.
+    _LEDGER_ENGINE_COMPONENTS = ("weights", "lora")
+    _LEDGER_BATCHER_COMPONENTS = (
+        "kv_arena", "block_tables", "draft_cache", "prefix_pool",
+        "ilv_mini", "grammar_arena", "tick_state",
+    )
+
+    def _memory_stats(self) -> dict:
+        """ServingStats memory_*_bytes fields from the engine ledger
+        (all zero when the ledger is off — the obs-off contract)."""
+        comp = self.engine.ledger.component_bytes(max_age_s=1.0)
+        out = {
+            f"memory_{name}_bytes": comp.get(("", name), 0)
+            for name in self._LEDGER_ENGINE_COMPONENTS
+        }
+        out.update({
+            f"memory_{name}_bytes": comp.get((self._ledger_scope, name), 0)
+            for name in self._LEDGER_BATCHER_COMPONENTS
+        })
+        return out
+
+    def _ledger_tick_snapshot(self) -> dict:
+        """component -> bytes for THIS tick's record (the timeline's
+        counter tracks). TTL-cached in the ledger: device shapes only
+        change on rebuild events, so the per-tick cost is a dict copy."""
+        comp = self.engine.ledger.component_bytes(max_age_s=1.0)
+        return {
+            name: b
+            for (scope, name), b in comp.items()
+            if scope in ("", self._ledger_scope) and b
+        }
+
     def counter_stats(self) -> dict:
         """Summable counters only (no percentiles) — what the tiered
         facade aggregates across tiers before computing percentiles
@@ -2210,6 +2295,11 @@ class ContinuousBatcher:
         counters and slot flags, safe to read stale."""
         t = self.timing
         return {
+            # Device-memory ledger components (serving/memory_ledger.py
+            # — "phase attribution for bytes"): weights/lora are
+            # engine-level (MAX_STAT_KEYS), the rest are this batcher's
+            # own allocations and sum across tiers.
+            **self._memory_stats(),
             # Mesh identity (docs/tensor_parallel_serving.md): the
             # tensor-axis size, total devices, human-readable shape,
             # and how many sharding specs compatible_spec downgraded to
@@ -3127,6 +3217,7 @@ class ContinuousBatcher:
             timed_out=self.timed_out,
             kv_pages_in_use=self.pages.in_use() if self._paged else 0,
             admit_ms=admit_ms,
+            memory=self._ledger_tick_snapshot(),
         )
 
     def _tick_dispatch(self) -> None:

@@ -1,0 +1,214 @@
+"""Compile watcher: every XLA compile in the serving process becomes a
+counter, a log line, and a timeline instant — the silent perf killer
+made loud.
+
+The persistent compile cache (PR 7) cut tier-1 wall time 40%, which is
+exactly why a steady-state recompile storm at serving time would be
+invisible today: the phase table (PR 9) shows the TIME going somewhere
+(a fat `dispatch` phase), but nothing says "that was a compile" or
+which program recompiled. XLA's compile-cache telemetry is the named
+prior art; this module taps the hooks this jax already exposes:
+
+* ``jax.monitoring`` events ``/jax/compilation_cache/cache_hits`` /
+  ``cache_misses`` — persistent-cache outcomes.
+* The ``jax._src.dispatch`` "Finished XLA compilation of <fn> in <s>
+  sec" log record — the only hook that carries the COMPILED FUNCTION'S
+  NAME, which is what turns "something recompiled" into "the decode
+  tick recompiled". The watcher claims that logger (level DEBUG,
+  propagate off) and re-emits through its own logger, so installing it
+  never spams the console with jax's per-trace debug lines.
+
+``mark_warm()`` draws the line between expected cold compiles (engine
+init + warmup ladders) and steady-state recompiles: every compile
+after the mark increments ``compile_post_warmup``, is logged at
+WARNING, and is flagged in the ring the timeline renders as an
+instant. Zero post-warmup compiles is the steady-state contract
+tests/test_memory.py pins.
+
+Process-global by necessity (jax's hooks are process-global); the
+sidecar exports the counters through ServingStats
+(``gateway_backend_compile_*``) and the ring through
+DebugService.GetMemory / GetFlightRecord. install() is idempotent and
+obs-gated at the engine (obs-off = never installed = zero work).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import re
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+logger = logging.getLogger("ggrmcp.serving.compile")
+
+# The dispatch-log shape (jax._src.dispatch.log_elapsed_time formats
+# the message before logging, so the record carries no args).
+_COMPILE_RE = re.compile(
+    r"^Finished XLA compilation of (?P<name>.+) in "
+    r"(?P<secs>[0-9.eE+-]+) sec"
+)
+_DISPATCH_LOGGER = "jax._src.dispatch"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+@dataclasses.dataclass
+class CompileEvent:
+    """One XLA compile as observed (serving_pb2.CompileRecord mirror)."""
+
+    fn_name: str
+    t_wall: float
+    duration_ms: float
+    post_warmup: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "fnName": self.fn_name,
+            "tWall": round(self.t_wall, 6),
+            "durationMs": round(self.duration_ms, 3),
+            "postWarmup": self.post_warmup,
+        }
+
+
+class _DispatchLogHandler(logging.Handler):
+    """Captures the dispatch logger's compile lines for a watcher."""
+
+    def __init__(self, watcher: "CompileWatcher"):
+        super().__init__(level=logging.DEBUG)
+        self._watcher = watcher
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:  # noqa: BLE001 — a log hook must never raise
+            return
+        m = _COMPILE_RE.match(msg)
+        if m is not None:
+            self._watcher._on_compile(
+                m.group("name"), float(m.group("secs"))
+            )
+
+
+class CompileWatcher:
+    """Counters + bounded ring of XLA compile events for this process."""
+
+    RING = 256
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._installed = False
+        self._handler: Optional[_DispatchLogHandler] = None
+        self.compile_count = 0
+        self.compile_ms = 0.0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self._count_at_warm: Optional[int] = None
+        self._ring: deque = deque(maxlen=self.RING)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def install(self) -> None:
+        """Attach the jax hooks (idempotent). Called at engine init
+        when serving.observability.enabled; never uninstalled — the
+        hooks are cheap and the counters process-global."""
+        with self._lock:
+            if self._installed:
+                return
+            self._installed = True
+        import jax.monitoring as monitoring
+
+        monitoring.register_event_listener(self._on_event)
+        # Claim the dispatch logger: DEBUG so the compile lines are
+        # emitted at all, propagate off so jax's per-trace debug spam
+        # never reaches the root handlers — the watcher re-logs what
+        # matters through its own logger.
+        dispatch = logging.getLogger(_DISPATCH_LOGGER)
+        self._handler = _DispatchLogHandler(self)
+        dispatch.addHandler(self._handler)
+        dispatch.setLevel(logging.DEBUG)
+        dispatch.propagate = False
+        logger.info("compile watcher installed")
+
+    def mark_warm(self) -> None:
+        """Declare warmup over: compiles from here on are steady-state
+        recompiles — counted, WARNING-logged, flagged in the ring. A
+        later mark (a second sidecar warming up in-process) re-draws
+        the line."""
+        with self._lock:
+            self._count_at_warm = self.compile_count
+
+    def mark_cold(self) -> None:
+        """A new warmup era opened (engine construction): compiles are
+        expected again until the next mark_warm(). Keeps a second
+        in-process serving stack's cold compiles from being flagged as
+        the first stack's steady-state recompiles."""
+        with self._lock:
+            self._count_at_warm = None
+
+    # -- hooks ---------------------------------------------------------------
+
+    def _on_event(self, event: str, **kwargs) -> None:
+        if event == _CACHE_HIT_EVENT:
+            with self._lock:
+                self.cache_hits += 1
+        elif event == _CACHE_MISS_EVENT:
+            with self._lock:
+                self.cache_misses += 1
+
+    def _on_compile(self, fn_name: str, secs: float) -> None:
+        with self._lock:
+            self.compile_count += 1
+            self.compile_ms += secs * 1000.0
+            post = self._count_at_warm is not None
+            self._ring.append(CompileEvent(
+                fn_name=fn_name,
+                t_wall=time.time(),
+                duration_ms=secs * 1000.0,
+                post_warmup=post,
+            ))
+        if post:
+            # THE log line: a compile after warmup means some shape or
+            # program escaped the warmup ladder — the classic silent
+            # tick-time cliff, now attributable by name.
+            logger.warning(
+                "steady-state recompile: %s took %.1f ms after warmup "
+                "(watch gateway_backend_compile_post_warmup)",
+                fn_name, secs * 1000.0,
+            )
+
+    # -- queries -------------------------------------------------------------
+
+    def post_warmup_count(self) -> int:
+        with self._lock:
+            if self._count_at_warm is None:
+                return 0
+            return self.compile_count - self._count_at_warm
+
+    def stats(self) -> dict:
+        """ServingStats field values (proto names, fields 101-105)."""
+        with self._lock:
+            post = (
+                self.compile_count - self._count_at_warm
+                if self._count_at_warm is not None else 0
+            )
+            return {
+                "compile_count": self.compile_count,
+                "compile_ms": round(self.compile_ms, 3),
+                "compile_cache_hits": self.cache_hits,
+                "compile_cache_misses": self.cache_misses,
+                "compile_post_warmup": post,
+            }
+
+    def snapshot(self, limit: int = RING) -> list:
+        """Newest-last compile events (the /debug/memory and timeline
+        instant source)."""
+        with self._lock:
+            events = list(self._ring)
+        return events[-max(1, limit):]
+
+
+# The process singleton (jax's hooks are process-global; so is this).
+watcher = CompileWatcher()

@@ -272,6 +272,58 @@ class GenerationEngine:
             self._generate_impl, static_argnums=(3, 4)
         )
         self._init_speculative(seed)
+        self._init_ledger()
+
+    def _init_ledger(self) -> None:
+        """Device-memory ledger + compile watcher (obs plane,
+        docs/observability.md). The engine owns the ledger — batchers
+        built over it register their components into the same instance
+        (per-tier scopes) so one reconcile() closes over the whole
+        serving stack. Suppliers read live attributes, so quantize/
+        LoRA/draft rebuilds are accounted automatically. Obs-off:
+        the ledger registers nothing and the watcher never installs —
+        zero work, like the flight recorder's disabled hooks."""
+        from ggrmcp_tpu.serving import compile_watcher
+        from ggrmcp_tpu.serving.memory_ledger import MemoryLedger
+
+        obs = getattr(self.serving, "observability", None)
+        enabled = bool(obs.enabled) if obs is not None else True
+        self.ledger = MemoryLedger(enabled=enabled)
+        self.ledger.register("weights", self._ledger_weights)
+        if self.lora_enabled:
+            self.ledger.register("lora", self._ledger_lora)
+        if enabled:
+            compile_watcher.watcher.install()
+            # A fresh engine opens a new warmup era: its cold compiles
+            # are expected, not steady-state recompiles (the sidecar
+            # re-marks warm when ITS warmup finishes).
+            compile_watcher.watcher.mark_cold()
+
+    def _ledger_weights(self):
+        """Target + draft model parameters (LoRA factors excluded —
+        they are their own component)."""
+        params = self.params
+        if self.lora_names and isinstance(params, dict):
+            params = {
+                **params,
+                "layers": {
+                    k: v for k, v in params["layers"].items()
+                    if not k.startswith("lora_")
+                },
+            }
+        out = [params]
+        if self.draft_fam is not None:
+            out.append(self.draft_params)
+        return out
+
+    def _ledger_lora(self):
+        """The stacked per-adapter factor arrays inside params."""
+        if not self.lora_names or not isinstance(self.params, dict):
+            return None
+        return {
+            k: v for k, v in self.params["layers"].items()
+            if k.startswith("lora_")
+        }
 
     def _note_downgrade(
         self, where: str, dim: int, entry, size: int, axis: int
@@ -1161,6 +1213,19 @@ class EmbeddingEngine:
         # params as an explicit argument, not a capture (same compile-
         # cache/lowering rationale as DecoderEngine).
         self._embed_fn = jax.jit(self._embed_impl, static_argnums=(3,))
+        # Memory ledger + compile watcher (same contract as
+        # GenerationEngine._init_ledger; an embed sidecar's weights are
+        # its one persistent allocation).
+        from ggrmcp_tpu.serving import compile_watcher
+        from ggrmcp_tpu.serving.memory_ledger import MemoryLedger
+
+        obs = getattr(self.serving, "observability", None)
+        enabled = bool(obs.enabled) if obs is not None else True
+        self.ledger = MemoryLedger(enabled=enabled)
+        self.ledger.register("weights", lambda: self.params)
+        if enabled:
+            compile_watcher.watcher.install()
+            compile_watcher.watcher.mark_cold()
 
     def _embed_impl(self, params, tokens, mask, pooling: str):
         return bert_mod.embed(params, self.cfg, tokens, mask, pooling)

@@ -48,12 +48,15 @@ from ggrmcp_tpu.core.config import ObservabilityConfig
 PHASE_NAMES = ("admit", "sync", "dispatch", "wait", "host")
 
 # The latencies the recorder distributes: the four lifecycle histograms
-# (ServingStatsResponse 34-45) plus one histogram per tick phase
-# (fields 67-81). Keys double as the stats() field prefixes:
+# (ServingStatsResponse 34-45), one histogram per tick phase (fields
+# 67-81), and the inter-token-latency (TPOT) histogram (106-108) —
+# per finished request, the mean gap between consecutive token
+# emissions, derived from the existing first/last lifecycle stamps.
+# Keys double as the stats() field prefixes:
 # <name>_bucket / <name>_sum / <name>_count.
 HISTOGRAM_NAMES = ("ttft_ms", "e2e_ms", "queue_ms", "tick_duration_ms") + tuple(
     f"tick_phase_{p}_ms" for p in PHASE_NAMES
-)
+) + ("tpot_ms",)
 
 
 class PhaseTimer:
@@ -115,6 +118,10 @@ class TickRecord:
     phase_dispatch_ms: float = 0.0
     phase_wait_ms: float = 0.0
     phase_host_ms: float = 0.0
+    # Device-memory ledger snapshot at dispatch (component -> bytes;
+    # empty when the ledger is off) — the timeline's counter-track
+    # source (proto memory_components/memory_component_bytes).
+    memory: dict = dataclasses.field(default_factory=dict)
     # The live timer carrying this tick's contiguous marks (None when
     # the recorder is disabled); not part of the proto mirror.
     phases: Optional[PhaseTimer] = dataclasses.field(
@@ -143,6 +150,10 @@ class TickRecord:
             "phaseDispatchMs": round(self.phase_dispatch_ms, 3),
             "phaseWaitMs": round(self.phase_wait_ms, 3),
             "phaseHostMs": round(self.phase_host_ms, 3),
+            "memoryComponents": list(self.memory),
+            "memoryComponentBytes": [
+                int(b) for b in self.memory.values()
+            ],
         }
 
 
@@ -245,6 +256,7 @@ class FlightRecorder:
         timed_out: int,
         kv_pages_in_use: int = 0,
         admit_ms: float = 0.0,
+        memory: Optional[dict] = None,
     ) -> Optional[TickRecord]:
         """Record a tick at dispatch; returns the record so the caller
         can carry it alongside the in-flight device call and complete
@@ -270,6 +282,7 @@ class FlightRecorder:
             trace_ids=trace_ids,
             source=self.source,
             kv_pages_in_use=kv_pages_in_use,
+            memory=memory or {},
         )
         self._admitted_since_tick = 0
         self._ticks.append(rec)
@@ -366,6 +379,15 @@ class FlightRecorder:
             if t_admit:
                 self._hists["queue_ms"].observe(queue_ms)
             self._hists["e2e_ms"].observe(e2e_ms)
+            if t_first and tokens > 1:
+                # TPOT: mean inter-token gap over the decode span,
+                # derived from the stamps already taken — one
+                # observation per multi-token request (a single-token
+                # request has no gaps and is skipped, exactly like a
+                # never-admitted timeout skips TTFT).
+                self._hists["tpot_ms"].observe(
+                    decode_s * 1000.0 / (tokens - 1)
+                )
 
     # -- snapshots ----------------------------------------------------------
 

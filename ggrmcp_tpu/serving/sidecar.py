@@ -842,6 +842,20 @@ class Sidecar:
         # it scores load from.
         stats["role"] = getattr(self.serving, "role", "mixed")
         stats.update(self._transfer_stats)
+        # Compile watcher (serving/compile_watcher.py): process-level
+        # XLA compile counters — count/wall/cache outcomes and the
+        # steady-state post-warmup recompiles (fields 101-105,
+        # gateway_backend_compile_*). Exported here, not per batcher:
+        # jax's hooks are process-global, exactly like the watcher.
+        from ggrmcp_tpu.serving.compile_watcher import watcher
+
+        stats.update(watcher.stats())
+        if self.batcher is None and self.embedding is not None:
+            # Embed-only sidecar: no batcher stats, but the weights
+            # component is still real — exported from the embed
+            # engine's ledger so /metrics never claims an empty HBM.
+            mem = self.embedding.ledger.component_bytes()
+            stats["memory_weights_bytes"] = mem.get(("", "weights"), 0)
         if self.batcher is not None:
             # Sidecar-owned grammar compile cache (the batcher/tiers
             # contribute grammar_masked_tokens / grammar_states_in_use).
@@ -961,7 +975,19 @@ class Sidecar:
             requests = sorted(
                 requests + spec_requests, key=lambda r: r.t_submit
             )[-max_requests:]
+        from ggrmcp_tpu.serving.compile_watcher import watcher
+
         return serving_pb2.FlightRecordResponse(
+            # Compile events ride the flight record so the unified
+            # timeline can render each as an instant on the same axis
+            # as the tick phases (process-global ring, newest last).
+            compiles=[
+                serving_pb2.CompileRecord(
+                    fn_name=c.fn_name, t_wall=c.t_wall,
+                    duration_ms=c.duration_ms, post_warmup=c.post_warmup,
+                )
+                for c in watcher.snapshot(max_ticks)
+            ],
             ticks=[
                 serving_pb2.TickRecord(
                     seq=t.seq, t_wall=t.t_wall, t_mono=t.t_mono,
@@ -979,6 +1005,10 @@ class Sidecar:
                     phase_dispatch_ms=t.phase_dispatch_ms,
                     phase_wait_ms=t.phase_wait_ms,
                     phase_host_ms=t.phase_host_ms,
+                    memory_components=list(t.memory),
+                    memory_component_bytes=[
+                        int(b) for b in t.memory.values()
+                    ],
                 )
                 for t in ticks
             ],
@@ -994,6 +1024,66 @@ class Sidecar:
                 for r in requests
             ],
             enabled=enabled,
+        )
+
+    async def get_memory(
+        self, request: serving_pb2.MemoryRequest, context
+    ):
+        """Device-memory ledger detail (serving/memory_ledger.py): the
+        full per-(scope, component) accounting behind the ServingStats
+        memory_* scalars, the closure reconciliation against JAX
+        live-buffer totals, and the compile watcher's counters + ring —
+        the gateway's GET /debug/memory body. Host-side walks only
+        (array metadata, never contents); run in the executor so a
+        large live-array census never blocks the event loop."""
+        from ggrmcp_tpu.serving.compile_watcher import watcher
+
+        engine = self.generation or self.embedding
+        ledger = getattr(engine, "ledger", None)
+        components: list = []
+        total = 0
+        live = unattr_bytes = unattr_arrays = 0
+        if ledger is not None and ledger.enabled:
+            loop = asyncio.get_running_loop()
+            if request.reconcile:
+                rec = await loop.run_in_executor(None, ledger.reconcile)
+                live = rec["live_bytes"]
+                unattr_bytes = rec["unattributed_bytes"]
+                unattr_arrays = len(rec["unattributed_arrays"])
+                per = {}
+                for name, b in rec["components"].items():
+                    scope, _, comp = name.rpartition("/")
+                    per[(scope, comp)] = b
+            else:
+                per = await loop.run_in_executor(
+                    None, ledger.component_bytes
+                )
+            for (scope, comp), b in sorted(per.items()):
+                components.append(serving_pb2.MemoryComponent(
+                    component=comp, scope=scope, bytes=int(b)
+                ))
+                total += int(b)
+        cstats = watcher.stats()
+        return serving_pb2.MemoryResponse(
+            components=components,
+            total_bytes=total,
+            live_bytes=live,
+            unattributed_bytes=unattr_bytes,
+            unattributed_arrays=unattr_arrays,
+            enabled=ledger is not None and ledger.enabled,
+            compile_count=cstats["compile_count"],
+            compile_ms=cstats["compile_ms"],
+            compile_cache_hits=cstats["compile_cache_hits"],
+            compile_cache_misses=cstats["compile_cache_misses"],
+            compile_post_warmup=cstats["compile_post_warmup"],
+            compiles=[
+                serving_pb2.CompileRecord(
+                    fn_name=c.fn_name, t_wall=c.t_wall,
+                    duration_ms=c.duration_ms,
+                    post_warmup=c.post_warmup,
+                )
+                for c in watcher.snapshot()
+            ],
         )
 
     # ------------------------------------------------------------------
@@ -1073,6 +1163,11 @@ class Sidecar:
                     serving_pb2.FlightRecordRequest,
                     serving_pb2.FlightRecordResponse,
                 ),
+                "GetMemory": MethodDef(
+                    self.get_memory,
+                    serving_pb2.MemoryRequest,
+                    serving_pb2.MemoryResponse,
+                ),
             },
         )
         ReflectionService(services).attach(self.server)
@@ -1108,6 +1203,13 @@ class Sidecar:
             self.batcher.start()
         if self.spec_batcher is not None:
             self.spec_batcher.start()
+        # Warmup is over: from here every XLA compile is a steady-state
+        # recompile — counted, WARNING-logged, and a timeline instant
+        # (serving/compile_watcher.py; compile_post_warmup == 0 is the
+        # serving-time contract `make test-mem` pins).
+        from ggrmcp_tpu.serving.compile_watcher import watcher
+
+        watcher.mark_warm()
         await self.server.start()
         engine = self.generation or self.embedding
         mesh_label = (

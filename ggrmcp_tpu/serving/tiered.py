@@ -78,7 +78,14 @@ class TieredBatcher:
                     if paged and budget else 0
                 ),
             )
-            tier_batcher = ContinuousBatcher(engine, tier_cfg, eos_id=eos_id)
+            # The ledger scope matches the flight-recorder source
+            # label, so "tier-512/kv_arena" in /debug/memory names the
+            # same pool as the tier's tick records — one vocabulary
+            # across the byte and time surfaces.
+            tier_batcher = ContinuousBatcher(
+                engine, tier_cfg, eos_id=eos_id,
+                ledger_scope=f"tier-{int(max_seq)}",
+            )
             # Tick seq counters are per-tier; the source label is what
             # keeps merged flight records unambiguous downstream.
             tier_batcher.recorder.source = f"tier-{int(max_seq)}"

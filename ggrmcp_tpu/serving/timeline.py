@@ -103,7 +103,11 @@ def _span_events(spans: list, events: list) -> None:
 def _tick_events(ticks: list, pid: int, events: list) -> None:
     """Tick records → one "ticks <source>" thread per source batcher:
     a parent slice per tick with its phase partition nested as child
-    slices, and lifecycle-counter deltas as instant markers."""
+    slices, lifecycle-counter deltas as instant markers, and counter
+    ("C") tracks for the paged-arena occupancy and the device-memory
+    ledger's per-component bytes — HBM pressure on the same time axis
+    as the phases (Perfetto renders each counter name as its own
+    track; the multi-series memory counter stacks its components)."""
     tids: dict[str, int] = {}
     prev: dict[str, dict] = {}  # source -> previous record's counters
     for tick in sorted(ticks, key=lambda t: _f(t.get("tWall"))):
@@ -155,6 +159,55 @@ def _tick_events(ticks: list, pid: int, events: list) -> None:
                     "args": {"delta": value - last.get(key, 0.0)},
                 })
             last[key] = value
+        ts_wall = _us(_f(tick.get("tWall")))
+        if "kvPagesInUse" in tick:
+            events.append({
+                "ph": "C", "cat": "memory",
+                "name": f"kv_pages_in_use {source or 'pool'}",
+                "ts": ts_wall, "pid": pid, "tid": tid,
+                "args": {"pages": _f(tick.get("kvPagesInUse"))},
+            })
+        comps = tick.get("memoryComponents") or []
+        if comps:
+            # One multi-series counter event: Perfetto stacks the
+            # components, so the track reads like the ledger's
+            # partition of HBM at this tick (int64 bytes arrive as
+            # protojson strings — _f both).
+            values = tick.get("memoryComponentBytes") or []
+            events.append({
+                "ph": "C", "cat": "memory",
+                "name": f"memory_bytes {source or 'pool'}",
+                "ts": ts_wall, "pid": pid, "tid": tid,
+                "args": {
+                    str(c): _f(v) for c, v in zip(comps, values)
+                },
+            })
+
+
+def _compile_events(compiles: list, pid: int, events: list) -> None:
+    """Compile-watcher ring → one "compiles" thread per sidecar: an
+    instant per XLA compile (name = the compiled program), so "that
+    slow tick was a recompile" reads straight off the timeline.
+    Post-warmup recompiles — the steady-state perf killer — are
+    flagged in args and use global scope so Perfetto draws them
+    full-height."""
+    if not compiles:
+        return
+    tid = 999  # below the request rows (1000+), above the tick tracks
+    events.append(_meta(pid, tid, "thread_name", "compiles"))
+    for rec in sorted(compiles, key=lambda c: _f(c.get("tWall"))):
+        post = bool(rec.get("postWarmup", False))
+        events.append({
+            "ph": "i", "cat": "compile",
+            "name": str(rec.get("fnName", "compile")),
+            "ts": _us(_f(rec.get("tWall"))),
+            "s": "g" if post else "t",
+            "pid": pid, "tid": tid,
+            "args": {
+                "durationMs": _f(rec.get("durationMs")),
+                "postWarmup": post,
+            },
+        })
 
 
 def _request_events(requests: list, pid: int, events: list) -> None:
@@ -221,6 +274,7 @@ def build_timeline(
             continue
         events.append(_meta(pid, 0, "process_name", f"sidecar {target}"))
         _tick_events(entry.get("ticks", []), pid, events)
+        _compile_events(entry.get("compiles", []), pid, events)
         _request_events(entry.get("requests", []), pid, events)
     # Stable per-track ordering: metadata first, then by start time;
     # ties break longest-slice-first so parents precede their nested

@@ -287,6 +287,103 @@ class TestAllocInJit:
 
 
 # ---------------------------------------------------------------------
+# 1c-bis. ledger-unregistered (ISSUE 13: HBM the ledger cannot see)
+# ---------------------------------------------------------------------
+
+
+class TestLedgerUnregistered:
+    # The pre-ledger shape: a persistent device cache on self with no
+    # memory-ledger component reading it — unattributed bytes in the
+    # next TPU window instead of a named line in /debug/memory.
+    HISTORICAL = """
+        class Batcher:
+            def __init__(self, engine):
+                self.cache = engine.make_cache(4, 256)
+    """
+
+    def test_fires_on_unregistered_allocation(self, tmp_path):
+        report = lint(
+            tmp_path, "ggrmcp_tpu/serving/batching.py", self.HISTORICAL
+        )
+        assert rule_ids(report) == ["ledger-unregistered"]
+        assert "self.cache" in report.findings[0].message
+        assert "ISSUE 13" in report.findings[0].precedent
+
+    def test_lambda_registration_passes(self, tmp_path):
+        report = lint(
+            tmp_path, "ggrmcp_tpu/serving/batching.py", """
+            class Batcher:
+                def __init__(self, engine):
+                    self.cache = engine.make_cache(4, 256)
+                    engine.ledger.register(
+                        "kv_arena", lambda: self.cache
+                    )
+            """,
+        )
+        assert report.clean
+
+    def test_method_supplier_registration_passes(self, tmp_path):
+        # One indirection hop: register("weights", self._supplier)
+        # scans the supplier method's body (the engine's real shape).
+        report = lint(
+            tmp_path, "ggrmcp_tpu/serving/engine2.py", """
+            class Engine:
+                def __init__(self):
+                    self.draft_params = _sharded_init(init, None, None)
+                    self.ledger.register("weights", self._weights)
+
+                def _weights(self):
+                    return [self.draft_params]
+            """,
+        )
+        assert report.clean
+
+    def test_host_numpy_and_other_dirs_exempt(self, tmp_path):
+        # np arrays are HOST memory (the ledger partitions device
+        # buffers); gateway modules are out of scope wholesale.
+        report = lint(
+            tmp_path, "ggrmcp_tpu/serving/batching.py", """
+            import numpy as np
+
+            class Batcher:
+                def __init__(self):
+                    self.cur_tokens = np.zeros((4,), np.int32)
+            """,
+        )
+        assert report.clean
+        report = lint(
+            tmp_path, "ggrmcp_tpu/gateway/cachez.py", self.HISTORICAL
+        )
+        assert report.clean
+
+    def test_flags_each_attr_once(self, tmp_path):
+        # Rebuild paths reassign the same attribute; one component
+        # registration covers them all, so one finding names them all.
+        report = lint(
+            tmp_path, "ggrmcp_tpu/serving/batching.py", """
+            class Batcher:
+                def __init__(self, engine):
+                    self.cache = engine.make_cache(4, 256)
+
+                def _rebuild(self):
+                    self.cache = self.engine.make_cache(4, 256)
+            """,
+        )
+        assert rule_ids(report) == ["ledger-unregistered"]
+
+    def test_pragma_suppresses(self, tmp_path):
+        report = lint(
+            tmp_path, "ggrmcp_tpu/serving/batching.py", """
+            class Batcher:
+                def __init__(self, engine):
+                    self.scratch = engine._snap_dev([0])  # graftlint: disable=ledger-unregistered -- fixture: transient debug scratch, freed next tick
+            """,
+        )
+        assert report.clean
+        assert len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------
 # 1d. async-hygiene (PR 2: swallowed CancelledError)
 # ---------------------------------------------------------------------
 

@@ -99,6 +99,7 @@ class TestProtoDrift:
             serving_gauge_names,
             serving_histogram_names,
             serving_info_names,
+            serving_memory_component_names,
         )
         from ggrmcp_tpu.rpc.pb import serving_pb2
 
@@ -106,8 +107,11 @@ class TestProtoDrift:
         gauges = set(serving_gauge_names())
         hists = set(serving_histogram_names())
         infos = set(serving_info_names())
+        memory = set(serving_memory_component_names())
         assert hists == {
             "ttft_ms", "e2e_ms", "queue_ms", "tick_duration_ms",
+            # Inter-token latency (fields 106-108).
+            "tpot_ms",
             # Tick-phase attribution: one histogram per phase, rendered
             # as ONE gateway_backend_tick_phase_ms{phase} family.
             *(f"tick_phase_{p}_ms"
@@ -117,11 +121,21 @@ class TestProtoDrift:
         # mesh_shape was the first, the serving role rides beside it; a
         # new string field lands there by construction.
         assert infos == {"mesh_shape", "role"}
+        # Memory-ledger fields render as the component label of ONE
+        # gateway_backend_memory_bytes family (never per-field gauges).
+        assert memory == {
+            "weights", "lora", "kv_arena", "block_tables",
+            "draft_cache", "prefix_pool", "ilv_mini", "grammar_arena",
+            "tick_state",
+        }
         assert not (gauges & infos)
         for field in desc.fields:
             covered = (
                 field.name in gauges
                 or field.name in infos
+                or field.name in {
+                    f"memory_{m}_bytes" for m in memory
+                }
                 or any(
                     field.name in
                     (f"{h}_bucket", f"{h}_sum", f"{h}_count")
@@ -133,6 +147,12 @@ class TestProtoDrift:
         # The TP-serving identity fields must stay exported as gauges —
         # the anti-masquerade contract (docs/tensor_parallel_serving.md).
         assert {"tp_chips", "mesh_devices", "mesh_spec_downgrades"} <= gauges
+        # The compile watcher's fields export as plain gauges
+        # (gateway_backend_compile_*).
+        assert {
+            "compile_count", "compile_ms", "compile_cache_hits",
+            "compile_cache_misses", "compile_post_warmup",
+        } <= gauges
 
         metrics = GatewayMetrics()
         if metrics.registry is None:
@@ -142,14 +162,30 @@ class TestProtoDrift:
         assert set(metrics.serving_gauges) == gauges
         metrics.set_serving_stats([{
             "target": "t1", "tpChips": 2, "meshShape": "tensor=2",
+            "memoryWeightsBytes": "1024", "compilePostWarmup": 3,
         }])
         rendered = metrics.render()[0].decode()
         assert 'gateway_backend_serving_mesh_info{' in rendered
         assert 'mesh_shape="tensor=2"' in rendered
         assert 'gateway_backend_tp_chips{target="t1"} 2.0' in rendered
-        # Target disappears → the info series must retire with it.
+        # The {component}-labeled memory family and the compile gauges.
+        assert (
+            'gateway_backend_memory_bytes{component="weights",'
+            'target="t1"} 1024.0' in rendered
+        )
+        assert (
+            'gateway_backend_memory_bytes{component="kv_arena",'
+            'target="t1"} 0.0' in rendered
+        )
+        assert (
+            'gateway_backend_compile_post_warmup{target="t1"} 3.0'
+            in rendered
+        )
+        # Target disappears → info series AND memory family retire.
         metrics.set_serving_stats([])
-        assert 'mesh_shape="tensor=2"' not in metrics.render()[0].decode()
+        rendered = metrics.render()[0].decode()
+        assert 'mesh_shape="tensor=2"' not in rendered
+        assert 'target="t1"' not in rendered
 
     def test_flight_recorder_stats_match_proto_fields(self):
         """histogram_stats() keys must be exact proto field names —

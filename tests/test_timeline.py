@@ -224,13 +224,21 @@ def _validate_chrome_trace(doc: dict) -> None:
     assert isinstance(events, list) and events
     per_track: dict = {}
     for ev in events:
-        assert ev["ph"] in {"X", "i", "M"}, ev
+        assert ev["ph"] in {"X", "i", "M", "C"}, ev
         assert isinstance(ev["pid"], int)
         assert isinstance(ev["tid"], int)
         assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
         assert isinstance(ev.get("name"), str) and ev["name"]
         if ev["ph"] == "X":
             assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        if ev["ph"] == "C":
+            # Counter tracks (memory ledger / paged occupancy): every
+            # series value must be numeric — Perfetto plots args as
+            # stacked series.
+            assert ev["args"], ev
+            assert all(
+                isinstance(v, (int, float)) for v in ev["args"].values()
+            ), ev
         if ev["ph"] != "M":
             per_track.setdefault((ev["pid"], ev["tid"]), []).append(
                 ev["ts"]
@@ -260,6 +268,13 @@ class TestTimelineEndpoint:
         _validate_chrome_trace(doc)
         cats = {e.get("cat") for e in doc["traceEvents"]}
         assert {"span", "tick", "tick.phase", "request"} <= cats
+        # Ledger counter tracks ride the same document: per-tick
+        # bytes-per-component "C" events (docs/observability.md).
+        counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+        assert any(
+            e["name"].startswith("memory_bytes") and "weights" in e["args"]
+            for e in counters
+        ), "no memory-ledger counter track on the timeline"
         instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
         assert any(e["name"] == "replay" for e in instants), (
             "injected tick failure left no lifecycle instant"
