@@ -7,7 +7,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: proto proto-check descriptors test test-all test-fast test-chaos \
   test-obs test-grammar test-spec-batch test-paged test-tp test-analysis \
-  test-disagg test-fleet test-mem bench-cpu smoke e2e lint graftlint \
+  test-disagg test-fleet test-mem test-kvtier bench-cpu smoke e2e lint graftlint \
   ci-local preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
@@ -150,6 +150,14 @@ test-fleet:
 # inner loop for serving/memory_ledger.py + compile_watcher.py work.
 test-mem:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m mem
+
+# Host-tier KV page pool net alone (CPU mesh): demote/restore
+# bit-identity, the 10x thrash bound, restore-failure chaos, file-tier
+# warm restarts, and the session-resume gateway e2e. Tier-1 runs these
+# too; this target is the fast inner loop for serving/host_pool.py +
+# pages.py host-tier work.
+test-kvtier:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m kvtier
 
 # ruff if present (baked CI image installs it; the TPU image may not).
 lint:

@@ -78,6 +78,19 @@ Environment knobs:
                          preamble agentic workload and exports tokens/s,
                          prefix hit rates, and KV HBM in use for both
                          modes (paged_* extras; docs/paged_kv.md).
+  GGRMCP_BENCH_KVTIER    host-tier KV page pool A/B phase ("on" by
+                         default off-TPU, "off" skips): two PAGED
+                         batchers — paged_kv_host_bytes 0 vs set —
+                         with the arena ~1/10 of the preamble working
+                         set, exporting tokens/s, demotion/restore
+                         page+byte traffic, and each mode's EFFECTIVE
+                         page hit rate (kvtier_* extras;
+                         docs/paged_kv.md "Host tier"). Knobs:
+                         GGRMCP_BENCH_KVTIER_SLOTS (2),
+                         GGRMCP_BENCH_KVTIER_PREAMBLES (40). The
+                         per-page restore-vs-recompute crossover is
+                         scripts/bench_kv_restore.py (own artifact,
+                         ready to re-run on-chip).
   GGRMCP_BENCH_REPLICAS=N  N-replica routing phase (standalone mode,
                          like PROXY_ONLY): spins N paged-KV sidecar
                          replica PROCESSES behind one gateway and
@@ -1351,6 +1364,21 @@ async def _run_bench() -> dict:
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: paged phase failed: {exc!r}", file=sys.stderr)
 
+    # Host-tier KV page pool A/B (GGRMCP_BENCH_KVTIER,
+    # docs/paged_kv.md "Host tier"): same isolation rationale — runs
+    # after the serving stack is down, on its own batchers.
+    kvtier = {}
+    want_kvtier = os.environ.get("GGRMCP_BENCH_KVTIER")
+    if want_kvtier == "on" or (
+        want_kvtier is None and not headline_only and not on_tpu
+    ):
+        try:
+            kvtier = await _kvtier_bench(
+                model, max_new, tick_steps, quantize, kv_dtype, synth,
+            )
+        except Exception as exc:  # secondary phase must not sink the run
+            print(f"bench: kvtier phase failed: {exc!r}", file=sys.stderr)
+
     # Tensor-parallel serving A/B (GGRMCP_BENCH_TP,
     # docs/tensor_parallel_serving.md): same isolation rationale —
     # runs after the serving stack is down, on its own engines.
@@ -1375,7 +1403,8 @@ async def _run_bench() -> dict:
             print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
     return {
         **headline, **hbm, **obs_export, **prefix, **longp, **mixed,
-        **grammar, **ticktime, **specbatch, **paged, **tp, **proxy,
+        **grammar, **ticktime, **specbatch, **paged, **kvtier, **tp,
+        **proxy,
     }
 
 
@@ -1609,6 +1638,137 @@ async def _paged_bench(
         "paged_on_kv_bytes": on["kv_bytes"],
         "paged_pages_in_use": on["pages_in_use"],
         "paged_cow_copies": on["cow"],
+    }
+
+
+async def _kvtier_bench(
+    model: str, max_new: int, tick_steps, quantize: str, kv_dtype: str,
+    synth: bool,
+) -> dict:
+    """Host-tier KV page pool A/B (docs/paged_kv.md "Host tier"): ONE
+    engine, two PAGED batchers — paged_kv_host_bytes 0 then set — with
+    the arena deliberately sized ~10x SMALLER than the preamble
+    working set (the regime where the device-only arena LRU-thrashes
+    and every re-visit is a full recompute). Exports tokens/s both
+    ways, demotion/restore page and byte traffic, and each mode's
+    EFFECTIVE page hit rate: (pages_reused + restores) /
+    (preamble pages per call x calls) — the fraction of re-visited
+    prefix pages served without recompute. The per-page
+    restore-vs-recompute crossover has its own instrument
+    (scripts/bench_kv_restore.py), ready to re-run on-chip."""
+    import asyncio as _asyncio
+
+    from ggrmcp_tpu.core.config import (
+        BatchingConfig, MeshConfig, ObservabilityConfig, ServingConfig,
+    )
+    from ggrmcp_tpu.models import get_model
+    from ggrmcp_tpu.ops.sampling import SamplingConfig
+    from ggrmcp_tpu.serving.batching import ContinuousBatcher
+    from ggrmcp_tpu.serving.engine import GenerationEngine
+
+    _, mcfg = get_model(model)
+    engine = GenerationEngine(mcfg, ServingConfig(
+        model=model,
+        quantize=quantize,
+        kv_cache_dtype=kv_dtype,
+        synthetic_weights=synth,
+        mesh=MeshConfig(tensor=0),
+        observability=ObservabilityConfig(enabled=False),
+    ))
+    slots = int(os.environ.get("GGRMCP_BENCH_KVTIER_SLOTS", "2"))
+    page_size = 16
+    pre_tokens = 64  # 4 full pages per preamble
+    pre_pages = pre_tokens // page_size
+    n_preambles = int(os.environ.get("GGRMCP_BENCH_KVTIER_PREAMBLES", "40"))
+    # Arena sized for ~10x thrash at the defaults: the live-row floor
+    # (so admissions themselves never shed), which the 40-preamble
+    # working set (160 pages) exceeds 10-fold.
+    arena_pages = max(
+        slots * (pre_pages + 4), n_preambles * pre_pages // 10
+    )
+    preambles = [
+        [(i * 13 + p * 71 + 5) % 199 + 3 for i in range(pre_tokens)]
+        for p in range(n_preambles)
+    ]
+    calls = 2 * n_preambles
+    greedy = SamplingConfig(temperature=0.0)
+    loop = _asyncio.get_running_loop()
+    runs: dict[str, dict] = {}
+    for mode in ("off", "on"):
+        batcher = ContinuousBatcher(engine, BatchingConfig(
+            max_batch_size=slots,
+            kv_cache_max_seq=512,
+            decode_steps_per_tick=tick_steps,
+            paged_kv="on",
+            paged_kv_page_size=page_size,
+            paged_kv_pages=arena_pages,
+            paged_kv_host_bytes=(512 << 20) if mode == "on" else 0,
+        ))
+        await loop.run_in_executor(None, batcher.warmup)
+        batcher.start()
+        try:
+            async def call(i: int, b=batcher):
+                out = []
+                async for ids, _reason in b.submit(
+                    preambles[i % n_preambles] + [3 + i % 97, 7],
+                    max(8, max_new), greedy, seed=i,
+                ):
+                    out.extend(ids)
+                return len(out)
+
+            # Seed wave off the clock: every preamble sighted once —
+            # the measured waves are re-visits.
+            await _asyncio.gather(*(
+                call(1000 + p) for p in range(n_preambles)
+            ))
+            s0 = batcher.counter_stats()
+            t0 = time.perf_counter()
+            tokens = sum(await _asyncio.gather(
+                *(call(i) for i in range(calls))
+            ))
+            elapsed = time.perf_counter() - t0
+            s1 = batcher.counter_stats()
+        finally:
+            await batcher.stop()
+        served = (
+            s1["paged_pages_reused"] - s0["paged_pages_reused"]
+            + s1["kv_host_restores"] - s0["kv_host_restores"]
+        )
+        runs[mode] = {
+            "tokens_per_sec": tokens / elapsed,
+            "effective_hit_rate": served / max(1, calls * pre_pages),
+            "demotions": s1["kv_host_demotions"],
+            "restores": s1["kv_host_restores"] - s0["kv_host_restores"],
+            "bytes_demoted": s1["kv_host_bytes_demoted"],
+            "bytes_restored": s1["kv_host_bytes_restored"],
+            "restore_failures": s1["kv_host_restore_failures"],
+            "host_bytes_used": s1["kv_host_bytes_used"],
+        }
+    off, on = runs["off"], runs["on"]
+    return {
+        "kvtier_model": model,
+        "kvtier_calls": calls,
+        "kvtier_preambles": n_preambles,
+        "kvtier_arena_pages": arena_pages,
+        "kvtier_working_set_pages": n_preambles * pre_pages,
+        "kvtier_off_tokens_per_sec": round(off["tokens_per_sec"], 1),
+        "kvtier_on_tokens_per_sec": round(on["tokens_per_sec"], 1),
+        "kvtier_uplift_pct": round(
+            (on["tokens_per_sec"] / off["tokens_per_sec"] - 1.0) * 100.0,
+            1,
+        ) if off["tokens_per_sec"] > 0 else 0.0,
+        "kvtier_off_effective_hit_rate": round(
+            off["effective_hit_rate"], 4
+        ),
+        "kvtier_on_effective_hit_rate": round(
+            on["effective_hit_rate"], 4
+        ),
+        "kvtier_demotions": on["demotions"],
+        "kvtier_restores": on["restores"],
+        "kvtier_bytes_demoted": on["bytes_demoted"],
+        "kvtier_bytes_restored": on["bytes_restored"],
+        "kvtier_restore_failures": on["restore_failures"],
+        "kvtier_host_bytes_used": on["host_bytes_used"],
     }
 
 

@@ -324,13 +324,19 @@ class LedgerUnregisteredRule(Rule):
     # Calls whose result is a persistent device allocation when stored
     # on self: the engine's cache/params factories, the batcher's
     # mini/shared-cache builders, replicated host→device snapshots,
-    # and jax/jnp zeros-family factories. np is HOST memory — exempt;
-    # asarray/array transfers are the unsharded-transfer rule's
-    # territory (usually transient jit inputs, its documented carve-out).
+    # and jax/jnp zeros-family factories. np is HOST memory — exempt
+    # EXCEPT the host-tier page pool (HostPagePool), whose byte-
+    # budgeted host buffers are exactly the kind of unaccounted memory
+    # the ledger exists for: it must register a host-bytes supplier
+    # (ledger.register_host) just as device allocations register
+    # device suppliers. asarray/array transfers are the
+    # unsharded-transfer rule's territory (usually transient jit
+    # inputs, its documented carve-out).
     _ALLOC_TAILS = {
         "make_cache", "make_paged_cache", "make_draft_cache",
         "_make_mini", "_make_shared_cache", "_snap_dev", "device_put",
         "_sharded_init", "_shard_params", "_synthetic_int8_init",
+        "HostPagePool",
     }
     _FACTORY_TAILS = {
         "zeros", "ones", "empty", "full", "zeros_like", "ones_like",
@@ -360,10 +366,10 @@ class LedgerUnregisteredRule(Rule):
         }
 
     def _registered_attrs(self, cls: ast.ClassDef) -> set:
-        """Attribute names any ledger.register() supplier reads —
-        directly (lambda args) or one method-reference hop away
-        (`register("weights", self._ledger_weights)` scans that
-        method's body)."""
+        """Attribute names any ledger.register() / register_host()
+        supplier reads — directly (lambda args) or one
+        method-reference hop away (`register("weights",
+        self._ledger_weights)` scans that method's body)."""
         methods = {
             n.name: n for n in ast.walk(cls)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
@@ -373,7 +379,10 @@ class LedgerUnregisteredRule(Rule):
             if not isinstance(node, ast.Call):
                 continue
             parts = call_name(node).split(".")
-            if parts[-1] != "register" or "ledger" not in parts:
+            if (
+                parts[-1] not in ("register", "register_host")
+                or "ledger" not in parts
+            ):
                 continue
             for arg in [*node.args, *(kw.value for kw in node.keywords)]:
                 out |= self._attrs_in(arg)

@@ -328,6 +328,29 @@ class BatchingConfig:
     # sharing then stretches (every shared prefix is stored once, and
     # freed pages are exact-fit reusable instead of padded rows).
     paged_kv_pages: int = 0
+    # Host-tier KV page pool (docs/paged_kv.md "Host tier"): > 0 turns
+    # arena eviction into DEMOTION — a refcount-0 indexed page's
+    # contents move to this byte-budgeted host-RAM pool (one D2H copy;
+    # int8 KV at half the bytes) and a later prefix hit on it RESTORES
+    # with one H2D copy instead of recomputing the prefill. The
+    # Mooncake/LMCache-style DRAM tier behind HBM: the hash-chain
+    # prefix index spans both tiers. 0 = off (eviction discards, the
+    # pre-tier behavior). With kv_tiers the budget splits across tiers
+    # proportional to KV volume, like paged_kv_pages.
+    paged_kv_host_bytes: int = 0
+    # Optional mmap'd file tier BEHIND the RAM pool: demotions write
+    # through to this append-only log, so a restarted replica warms
+    # from disk (chain keys are stable across processes) — the fleet
+    # supervisor's drain → restart cycle re-admits sessions from the
+    # persisted pool instead of recomputing (docs/fleet.md). Requires
+    # paged_kv_host_bytes > 0. With kv_tiers each tier logs to
+    # "<path>.tier-<max_seq>" (tiers share no mutable state).
+    paged_kv_host_path: str = ""
+    # Cap on the file tier's log size in bytes (0 = unbounded; the log
+    # is append-only, so long-lived replicas with churning working
+    # sets should set this). When full, demotions keep landing in RAM
+    # — the file just stops growing.
+    paged_kv_host_file_bytes: int = 0
     # Prefix (prompt-KV) cache: a device-resident pool of recently seen
     # prompt prefixes; an admission whose prompt starts with a cached
     # prefix reuses its KV and prefills only the suffix — the
@@ -1174,6 +1197,35 @@ class Config:
                         "pools: kv_tiers prefix_entries must be 0 "
                         "under paging"
                     )
+        if batching.paged_kv_host_bytes < 0:
+            raise ValueError(
+                "batching.paged_kv_host_bytes must be >= 0 (0 = no "
+                "host tier)"
+            )
+        if batching.paged_kv_host_file_bytes < 0:
+            raise ValueError(
+                "batching.paged_kv_host_file_bytes must be >= 0 "
+                "(0 = unbounded log)"
+            )
+        if batching.paged_kv_host_bytes and batching.paged_kv != "on":
+            raise ValueError(
+                "batching.paged_kv_host_bytes requires paged_kv=on: "
+                "the host tier demotes and restores PAGES "
+                "(docs/paged_kv.md 'Host tier')"
+            )
+        if batching.paged_kv_host_path and not batching.paged_kv_host_bytes:
+            raise ValueError(
+                "batching.paged_kv_host_path is the file tier BEHIND "
+                "the host RAM pool: set paged_kv_host_bytes > 0"
+            )
+        if (
+            batching.paged_kv_host_file_bytes
+            and not batching.paged_kv_host_path
+        ):
+            raise ValueError(
+                "batching.paged_kv_host_file_bytes caps the file-tier "
+                "log: set paged_kv_host_path"
+            )
         if batching.prefix_cache_entries < 0:
             raise ValueError("prefix_cache_entries must be >= 0")
         if batching.prefix_cache_entries:

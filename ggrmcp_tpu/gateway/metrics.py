@@ -172,6 +172,28 @@ _SERVING_HELP = {
     "compile_post_warmup":
         "steady-state recompiles after the warmup mark (must stop "
         "growing once first traffic settles)",
+    # Host-tier KV page pool (batching.paged_kv_host_bytes,
+    # docs/paged_kv.md "Host tier"): DRAM behind the HBM page arena.
+    # (paged_pages_reused + kv_host_restores) / paged_pages_admitted
+    # is the effective hit rate — admission pages not recomputed.
+    "kv_host_entries": "host-tier KV pages resident in RAM",
+    "kv_host_bytes_used": "host-tier RAM pool bytes in use",
+    "kv_host_budget_bytes":
+        "host-tier RAM pool byte budget (paged_kv_host_bytes)",
+    "kv_host_file_entries":
+        "host-tier pages persisted in the mmap'd file tier",
+    "kv_host_file_bytes": "host-tier file-tier log bytes",
+    "kv_host_demotions":
+        "arena pages demoted D2H to the host tier instead of "
+        "discarded",
+    "kv_host_restores":
+        "demoted pages restored H2D on a prefix hit instead of "
+        "recomputed",
+    "kv_host_bytes_demoted": "payload bytes demoted D2H (cumulative)",
+    "kv_host_bytes_restored": "payload bytes restored H2D (cumulative)",
+    "kv_host_restore_failures":
+        "admissions whose restore failed and degraded typed to "
+        "recompute (bit-identical output, just slower)",
 }
 
 _SERVING_HIST_HELP = {

@@ -41,6 +41,7 @@ from ggrmcp_tpu.ops.sampling import (
     sample_dynamic,
 )
 from ggrmcp_tpu.serving.engine import bucket_len, fit_request
+from ggrmcp_tpu.serving import tensors
 from ggrmcp_tpu.serving.flight_recorder import PHASE_NAMES, FlightRecorder
 from ggrmcp_tpu.serving.pages import PageAllocator, PageExhaustedError
 from ggrmcp_tpu.utils import failpoints
@@ -378,8 +379,40 @@ class ContinuousBatcher:
             self.cache = engine.make_paged_cache(
                 b, s_max, self._n_pages, page
             )
+            # Host-tier page pool (batching.paged_kv_host_bytes > 0,
+            # docs/paged_kv.md "Host tier"): arena eviction demotes
+            # page contents D2H into this byte-budgeted host pool and
+            # admission restores demoted prefixes H2D instead of
+            # recomputing them. The allocator owns placement; the two
+            # hooks below are its device halves (gather+pack /
+            # unpack+write), both running inside this batcher's
+            # serialized executor stream.
+            host_bytes = int(
+                getattr(self.cfg, "paged_kv_host_bytes", 0) or 0
+            )
+            if host_bytes > 0:
+                from ggrmcp_tpu.serving.host_pool import HostPagePool
+
+                self.host_pool = HostPagePool(
+                    host_bytes,
+                    geometry=self._kv_page_geometry(),
+                    file_path=(
+                        getattr(self.cfg, "paged_kv_host_path", "") or ""
+                    ),
+                    file_budget_bytes=int(
+                        getattr(self.cfg, "paged_kv_host_file_bytes", 0)
+                        or 0
+                    ),
+                )
+                self.pages.attach_host(
+                    self.host_pool, self._demote_fetch,
+                    self._restore_write,
+                )
+            else:
+                self.host_pool = None
         else:
             self.pages = None
+            self.host_pool = None
             self.cache = engine.make_cache(b, s_max)
         # Spec mode: the draft's KV slot pool rides beside the shared
         # target cache (the cache-level merge docs/speculative.md's
@@ -668,6 +701,18 @@ class ContinuousBatcher:
             lambda: (self._cur_dev, self._prev_dev, self._gstate_dev),
             scope=ledger_scope,
         )
+        # Host-tier bytes are HOST memory — outside jax.live_arrays(),
+        # so they ride the ledger's host-supplier side instead of the
+        # device closure: /debug/memory renders them as the `host`
+        # section beside the reconciliation.
+        engine.ledger.register_host(
+            "host_pool",
+            lambda: (
+                self.host_pool.memory_info()
+                if self.host_pool is not None else None
+            ),
+            scope=ledger_scope,
+        )
 
     def _make_mini(self, rows: int, length: int):
         """Admission mini cache matching the engine's KV storage."""
@@ -859,24 +904,124 @@ class ContinuousBatcher:
             return 0, present
         dst = np.asarray([p for _, p in placed], np.int32)
         src = np.asarray([j - start_page for j, _ in placed], np.int32)
+        self._write_arena_pages(
+            dst, k[:, src], v[:, src],
+            k_scale[:, src] if quantized else None,
+            v_scale[:, src] if quantized else None,
+        )
+        return len(placed), present
+
+    def _write_arena_pages(
+        self,
+        dst: np.ndarray,
+        k: np.ndarray,
+        v: np.ndarray,
+        k_scale: "Optional[np.ndarray]" = None,
+        v_scale: "Optional[np.ndarray]" = None,
+    ) -> None:
+        """H2D write of [L, n, P, KVH, Dh] page contents into arena
+        pages `dst` — the ONE device-write shared by the TransferKV
+        import and the host-tier restore, so the two paths cannot
+        drift. Geometry/dtype are re-validated here (cheap, and the
+        restore path has no other gate). Dispatches inside the
+        caller's serialized stream: any later admission's gather reads
+        the new contents by device ordering."""
+        arena_k = self.cache.k
+        quantized = isinstance(arena_k, quant.QuantizedArray)
+        if quantized != (k_scale is not None):
+            raise KVTransferError(
+                "kv dtype mismatch: page payload and arena must both "
+                "use int8 KV or neither (serving.kv_cache_dtype)"
+            )
+        ref = arena_k.q if quantized else arena_k
+        want = (ref.shape[0],) + ref.shape[2:]  # [L, P, KVH, Dh]
+        got = (k.shape[0],) + k.shape[2:]
+        if got != want or v.shape != k.shape:
+            raise KVTransferError(
+                f"kv page geometry mismatch: got {got}, arena wants "
+                f"{want} (layers, page_size, kv_heads, head_dim)"
+            )
 
         def put(a, m):
             return a.at[:, dst].set(self._snap_dev(m).astype(a.dtype))
 
         if quantized:
             new_k = quant.QuantizedArray(
-                q=put(arena_k.q, k[:, src]),
-                scale=put(arena_k.scale, k_scale[:, src]),
+                q=put(arena_k.q, k),
+                scale=put(arena_k.scale, k_scale),
             )
             new_v = quant.QuantizedArray(
-                q=put(self.cache.v.q, v[:, src]),
-                scale=put(self.cache.v.scale, v_scale[:, src]),
+                q=put(self.cache.v.q, v),
+                scale=put(self.cache.v.scale, v_scale),
             )
         else:
-            new_k = put(arena_k, k[:, src])
-            new_v = put(self.cache.v, v[:, src])
+            new_k = put(arena_k, k)
+            new_v = put(self.cache.v, v)
         self.cache = self.cache._replace(k=new_k, v=new_v)
-        return len(placed), present
+
+    # -- host-tier hooks (serving/host_pool.py via pages.attach_host) -------
+
+    def _kv_page_geometry(self) -> str:
+        """Page-shape/dtype signature guarding the host pool's file
+        tier: a restarted replica with a different arena geometry must
+        start fresh, never restore wrong-shaped KV."""
+        leaf = self.cache.k
+        quantized = isinstance(leaf, quant.QuantizedArray)
+        ref = leaf.q if quantized else leaf
+        shape = (ref.shape[0],) + ref.shape[2:]
+        return "x".join(str(d) for d in shape) + f":{ref.dtype}" + (
+            ":int8" if quantized else ""
+        )
+
+    def _demote_fetch(self, pages: list[int]) -> list[bytes]:
+        """D2H gather + pack of arena pages about to be evicted (the
+        allocator's demotion half): ONE device gather for the whole
+        victim set, one packed KVPagePayload per page — the exact
+        codec TransferKV ships pages with (serving/tensors.py)."""
+        idx = np.asarray(pages, np.int32)
+        gathered: dict = {}
+        for name, leaf in (("k", self.cache.k), ("v", self.cache.v)):
+            if isinstance(leaf, quant.QuantizedArray):
+                gathered[name] = np.asarray(leaf.q[:, idx])
+                gathered[name + "_scale"] = np.asarray(leaf.scale[:, idx])
+            else:
+                gathered[name] = np.asarray(leaf[:, idx])
+        quantized = "k_scale" in gathered
+        return [
+            tensors.pack_kv_pages(
+                gathered["k"][:, i:i + 1], gathered["v"][:, i:i + 1],
+                gathered["k_scale"][:, i:i + 1] if quantized else None,
+                gathered["v_scale"][:, i:i + 1] if quantized else None,
+            )
+            for i in range(len(pages))
+        ]
+
+    def _restore_write(self, pages: list[int], blobs: list[bytes]) -> None:
+        """Unpack + H2D write of restored host-tier pages (the
+        allocator's restore half). Raises on the host_restore_fail
+        chaos hook or any unpack/geometry error — the allocator
+        degrades the admission TYPED to recompute, never a silent
+        half-restore (all pages ride one batched write)."""
+        failpoints.evaluate("host_restore_fail")
+        ks, vs, kss, vss = [], [], [], []
+        for blob in blobs:
+            k, v, k_s, v_s = tensors.unpack_kv_pages(blob)
+            ks.append(k)
+            vs.append(v)
+            if k_s is not None:
+                kss.append(k_s)
+                vss.append(v_s)
+        if kss and len(kss) != len(ks):
+            raise KVTransferError(
+                "mixed int8/unquantized payloads in one restore set"
+            )
+        self._write_arena_pages(
+            np.asarray(pages, np.int32),
+            np.concatenate(ks, axis=1),
+            np.concatenate(vs, axis=1),
+            np.concatenate(kss, axis=1) if kss else None,
+            np.concatenate(vss, axis=1) if kss else None,
+        )
 
     # -- grammar host side (serving/batching owns residency + states) -------
 
@@ -2035,6 +2180,11 @@ class ContinuousBatcher:
             _, fut = self._host_ops.popleft()
             if not fut.done():
                 fut.set_exception(RuntimeError("batcher stopped"))
+        # Release the host pool's file tier (appends are flushed per
+        # record, so the warm-restart log is already durable; the pool
+        # keeps serving RAM-only if the batcher restarts in-process).
+        if self.host_pool is not None:
+            self.host_pool.close()
 
     async def run_host_op(self, fn):
         """Run `fn()` (host + device work) in the batcher's serialized
@@ -2333,6 +2483,15 @@ class ContinuousBatcher:
                 "kv_pages_shared": 0, "paged_prefix_hits": 0,
                 "paged_cow_copies": 0, "paged_pages_reused": 0,
                 "paged_pages_admitted": 0,
+                # Host tier (paged_kv_host_bytes; all 0 when paging or
+                # the tier is off — the allocator's stats() carries
+                # the live values when on).
+                "kv_host_entries": 0, "kv_host_bytes_used": 0,
+                "kv_host_budget_bytes": 0, "kv_host_file_entries": 0,
+                "kv_host_file_bytes": 0, "kv_host_demotions": 0,
+                "kv_host_restores": 0, "kv_host_bytes_demoted": 0,
+                "kv_host_bytes_restored": 0,
+                "kv_host_restore_failures": 0,
             }),
             # Interleaved (tick-fused) admission activity: chunks
             # piggybacked onto decode ticks / requests admitted that way.

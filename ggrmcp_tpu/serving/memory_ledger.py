@@ -80,6 +80,11 @@ class MemoryLedger:
         self.enabled = bool(enabled)
         # (scope, component) -> supplier returning the live array tree.
         self._suppliers: dict[tuple[str, str], Callable[[], Any]] = {}
+        # (scope, component) -> supplier returning a HOST-memory info
+        # dict (bytes/entries/budget_bytes/file_*) or None when the
+        # component is off — host bytes live outside jax.live_arrays(),
+        # so they ride beside the device closure, never inside it.
+        self._host_suppliers: dict[tuple[str, str], Callable[[], Any]] = {}
         self._lock = threading.Lock()
         self._cache: tuple[float, dict] = (0.0, {})
 
@@ -92,6 +97,20 @@ class MemoryLedger:
         if not self.enabled:
             return
         self._suppliers[(scope, component)] = supplier
+
+    def register_host(
+        self, component: str, supplier: Callable[[], Any], scope: str = ""
+    ) -> None:
+        """Attach a HOST-memory component (e.g. the host-tier KV page
+        pool, serving/host_pool.py). The supplier returns a dict with
+        at least `bytes` and `entries` (plus budget/file fields), or
+        None when the component is disabled. Host bytes are exact by
+        construction — the owner counts what it stores — so they have
+        no reconcile pass; they render as the `host` section of
+        GET /debug/memory. Same obs-off contract as register()."""
+        if not self.enabled:
+            return
+        self._host_suppliers[(scope, component)] = supplier
 
     # -- queries -------------------------------------------------------------
 
@@ -134,6 +153,19 @@ class MemoryLedger:
 
     def total_bytes(self) -> int:
         return sum(self.component_bytes().values())
+
+    def host_components(self) -> dict[tuple[str, str], dict]:
+        """(scope, component) -> host-memory info dict for every
+        registered host supplier whose component is live (None
+        supplier results — disabled pools — are skipped). Supplier
+        errors surface like component_arrays(): an owner bug, never a
+        silently-short section."""
+        out: dict[tuple[str, str], dict] = {}
+        for key, supplier in self._host_suppliers.items():
+            info = supplier()
+            if info is not None:
+                out[key] = info
+        return out
 
     # -- closure -------------------------------------------------------------
 

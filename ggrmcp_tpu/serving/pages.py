@@ -10,6 +10,16 @@ the free list, the token-content prefix index, LRU eviction stamps, and
 the block tables themselves (the batcher uploads a table snapshot
 before each device call; the device never allocates).
 
+Since ISSUE 14 the prefix index spans TWO tiers: eviction under
+pressure DEMOTES refcount-0 indexed pages' contents to a host-RAM pool
+(serving/host_pool.py, one D2H copy) instead of discarding them, and
+the admission lookup extends past the device-resident chain into host
+entries — a prefix hit on a demoted page is one H2D restore instead of
+a recomputed prefill (Mooncake/LMCache-style DRAM behind HBM,
+docs/paged_kv.md "Host tier"). Chain keys are shared across tiers and
+stable across processes, so an mmap'd file tier gives restarted
+replicas warm restores.
+
 vLLM's PagedAttention supplies the arena/block-table storage model;
 SGLang's radix-tree prefix matching supplies the lookup discipline —
 realized here as a hash CHAIN over page contents: page j of a prompt is
@@ -43,7 +53,10 @@ like the old prefix-pool maps this module replaces).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import heapq
 import logging
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -82,6 +95,11 @@ class PageAdmission:
     scan_start: int
     gather_row: np.ndarray
     pages_shared: int
+    # Prefix pages served by an H2D restore from the host tier (a
+    # subset of pages_shared; 0 without a host pool). Restored pages
+    # are re-indexed at refcount > 0, so from here on they are
+    # ordinary shared device pages — the proven sharing path.
+    pages_restored: int = 0
 
 
 class PageAllocator:
@@ -111,6 +129,15 @@ class PageAllocator:
         # LRU stamps for refcount-0 indexed pages (the evictable set).
         self._stamp: dict[int, int] = {}
         self._clock = 0
+        # Host tier (serving/host_pool.py, attach_host): eviction
+        # demotes page contents D2H instead of discarding, and the
+        # prefix lookup extends past the device-resident chain into
+        # host entries, restoring them H2D at admission. The two hooks
+        # are the batcher's device halves: fetch gathers + packs
+        # victim pages, restore unpacks + writes restored pages.
+        self.host = None
+        self._fetch_pages: Optional[Callable] = None
+        self._restore_pages: Optional[Callable] = None
         # Counters (ServingStats): admissions that reused shared pages
         # or a CoW source / that found nothing; cumulative pages
         # reference-shared instead of recomputed; divergent-page copies.
@@ -119,6 +146,14 @@ class PageAllocator:
         self.pages_reused = 0
         self.pages_admitted = 0
         self.cow_copies = 0
+        # Host-tier traffic (all 0 without a host pool): pages demoted
+        # D2H / restored H2D, payload bytes both ways, and admissions
+        # whose restore failed and degraded typed to recompute.
+        self.host_demotions = 0
+        self.host_restores = 0
+        self.host_bytes_demoted = 0
+        self.host_bytes_restored = 0
+        self.host_restore_failures = 0
 
     # -- stats ---------------------------------------------------------------
 
@@ -145,13 +180,68 @@ class PageAllocator:
             # replica-routing bench A/Bs (docs/routing.md).
             "paged_pages_reused": self.pages_reused,
             "paged_pages_admitted": self.pages_admitted,
+            # Host tier (docs/paged_kv.md "Host tier"): traffic
+            # counters here, occupancy gauges from the pool itself.
+            # (pages_reused + host_restores) / pages_admitted is the
+            # EFFECTIVE hit rate — admission pages not recomputed.
+            "kv_host_demotions": self.host_demotions,
+            "kv_host_restores": self.host_restores,
+            "kv_host_bytes_demoted": self.host_bytes_demoted,
+            "kv_host_bytes_restored": self.host_bytes_restored,
+            "kv_host_restore_failures": self.host_restore_failures,
+            **(
+                self.host.stats() if self.host is not None else {
+                    "kv_host_entries": 0, "kv_host_bytes_used": 0,
+                    "kv_host_budget_bytes": 0,
+                    "kv_host_file_entries": 0, "kv_host_file_bytes": 0,
+                }
+            ),
         }
+
+    # -- host tier -----------------------------------------------------------
+
+    def attach_host(
+        self, pool, fetch: Callable, restore: Callable
+    ) -> None:
+        """Wire the host tier in. `fetch(pages) -> list[bytes]` gathers
+        the arena pages D2H and packs each one (tensors.pack_kv_pages);
+        `restore(pages, blobs)` unpacks and writes blobs into arena
+        pages H2D. Both run inside the batcher's serialized executor
+        stream (demote inside _reclaim, restore inside admit), so
+        neither can interleave with a tick, an admission, or a
+        TransferKV host op."""
+        self.host = pool
+        self._fetch_pages = fetch
+        self._restore_pages = restore
 
     # -- prefix index --------------------------------------------------------
 
     @staticmethod
     def _chain(parent: int, tokens: np.ndarray) -> int:
-        return hash((parent, tokens.tobytes()))
+        # STABLE across processes (blake2b, not the PYTHONHASHSEED-
+        # salted builtin): the host pool's file tier persists entries
+        # by chain key, so a restarted replica must re-derive the SAME
+        # keys from the same prompts to warm-restore (docs/fleet.md).
+        # Collisions verify as misses against the stored tokens, here
+        # and in the host pool alike.
+        h = hashlib.blake2b(digest_size=8)
+        h.update(parent.to_bytes(8, "little", signed=True))
+        h.update(tokens.tobytes())
+        return int.from_bytes(h.digest(), "little", signed=True)
+
+    def _probe_cow(self, key: int, rem: np.ndarray) -> tuple[int, int]:
+        """Best partially matching divergent page among `key`'s indexed
+        children vs the request's next tokens `rem`. Returns
+        (cow_page or -1, matching-token overlap)."""
+        cow_page, cow_t = -1, 0
+        for page in self._children.get(key, ()):
+            cached = self._tokens_of[page]
+            n = min(len(cached), len(rem))
+            neq = np.nonzero(cached[:n] != rem[:n])[0]
+            t = int(neq[0]) if neq.size else n
+            if t > cow_t:
+                cow_page, cow_t = page, t
+        return cow_page, cow_t
 
     def _lookup(self, arr: np.ndarray, limit: int) -> tuple[list, int, int, int]:
         """Longest page-aligned indexed prefix of arr[:limit] plus the
@@ -169,15 +259,9 @@ class PageAllocator:
             pages.append(page)
             key = nxt
         m = len(pages)
-        rem = arr[m * p: min(limit, (m + 1) * p)]
-        cow_page, cow_t = -1, 0
-        for page in self._children.get(key, ()):
-            cached = self._tokens_of[page]
-            n = min(len(cached), len(rem))
-            neq = np.nonzero(cached[:n] != rem[:n])[0]
-            t = int(neq[0]) if neq.size else n
-            if t > cow_t:
-                cow_page, cow_t = page, t
+        cow_page, cow_t = self._probe_cow(
+            key, arr[m * p: min(limit, (m + 1) * p)]
+        )
         return pages, key, cow_page, cow_t
 
     def _unindex(self, page: int) -> None:
@@ -194,20 +278,75 @@ class PageAllocator:
                 self._children.pop(parent, None)
         self._tokens_of.pop(page, None)
 
-    def _reclaim(self, need: int) -> None:
+    def _demote(self, victims: list[int]) -> None:
+        """Move the victims' page contents to the host tier before
+        they leave the index — eviction becomes one batched D2H copy
+        instead of a discard. Best-effort: a fetch failure logs and
+        degrades to the old discard behavior (recompute on next
+        sighting), never blocks the admission that needed the pages.
+        Pages whose chain key the pool already holds (demoted before,
+        restored, evicted again) skip the D2H — the host copy is
+        bit-identical by construction (indexed pages are immutable)."""
+        if self.host is None or self._fetch_pages is None:
+            return
+        todo = [
+            page for page in victims
+            if not self.host.has(self._key_of[page], self._tokens_of[page])
+        ]
+        self.host_demotions += len(victims)
+        if not todo:
+            return
+        try:
+            blobs = self._fetch_pages(todo)
+        except Exception as exc:  # noqa: BLE001 — degrade to discard
+            self.host_demotions -= len(todo)
+            logger.warning("host-tier demotion failed (D2H): %s", exc)
+            return
+        for page, blob in zip(todo, blobs):
+            self.host.put(
+                self._key_of[page], self._parent_of[page],
+                self._tokens_of[page], blob,
+            )
+            self.host_bytes_demoted += len(blob)
+
+    def _reclaim(self, need: int, keep: frozenset = frozenset()) -> None:
         """Evict refcount-0 indexed pages, LRU first, until `need`
-        pages are free. All-or-nothing: raises before mutating anything
-        if the evictable set cannot cover the shortfall."""
+        pages are free — demoting their contents to the host tier when
+        one is attached. All-or-nothing: raises before mutating
+        anything if the evictable set cannot cover the shortfall.
+
+        `keep` excludes pages the CALLING admission just matched from
+        victim selection: a matched refcount-0 page is still in the
+        evictable set, and evicting it here would let the admission
+        refcount a freed page (and hand the same page out again as
+        `fresh`) — silent table corruption under exactly the pressure
+        the tier exists for.
+
+        heapq.nsmallest keeps victim selection O(E log shortfall)
+        instead of sorting the whole stamp dict (O(E log E)) on every
+        shortfall — the allocator's hottest path under sustained
+        pressure (same victims, property-tested)."""
         shortfall = need - len(self._free)
         if shortfall <= 0:
             return
-        if shortfall > len(self._stamp):
+        if keep:
+            evictable = len(self._stamp) - sum(
+                1 for page in keep if page in self._stamp
+            )
+            candidates = (p for p in self._stamp if p not in keep)
+        else:
+            evictable = len(self._stamp)
+            candidates = self._stamp
+        if shortfall > evictable:
             raise PageExhaustedError(
                 f"page pool exhausted: need {need} pages, "
-                f"{len(self._free)} free + {len(self._stamp)} evictable "
+                f"{len(self._free)} free + {evictable} evictable "
                 f"of {self.n_pages}"
             )
-        victims = sorted(self._stamp, key=self._stamp.__getitem__)[:shortfall]
+        victims = heapq.nsmallest(
+            shortfall, candidates, key=self._stamp.__getitem__
+        )
+        self._demote(victims)
         for page in victims:
             del self._stamp[page]
             self._unindex(page)
@@ -238,38 +377,194 @@ class PageAllocator:
         # produce sampling logits — cap reuse at len(prompt) - 1.
         limit = len(prompt) - 1
         if share:
-            shared, _, cow_page, cow_t = self._lookup(arr, limit)
+            shared, break_key, cow_page, cow_t = self._lookup(arr, limit)
         else:
-            shared, cow_page, cow_t = [], -1, 0
+            shared, break_key, cow_page, cow_t = [], _ROOT, -1, 0
         m = len(shared)
-        self._reclaim(w_need - m)  # may raise; nothing mutated yet
-        fresh = [self._free.pop() for _ in range(w_need - m)]
-        for page in shared:
+        # Host-tier extension (attach_host): continue the chain walk
+        # past the device break — orphaned device pages re-link free,
+        # host-tier entries restore with one batched H2D write.
+        ext: list[tuple[str, int, int]] = []
+        if share and self.host is not None:
+            ext = self._extend_lookup(arr, limit, m, break_key)
+        n_dev = sum(1 for kind, _, _ in ext if kind == "dev")
+        # Exclude every matched page from victim selection: a matched
+        # refcount-0 page is in the evictable set, and evicting it
+        # below would refcount a freed page and hand it out again as
+        # fresh — the keep set closes that corruption window.
+        keep = frozenset(shared) | frozenset(
+            page for kind, _, page in ext if kind == "dev"
+        )
+        # may raise; nothing mutated yet (demotion only fills the host
+        # pool — additive, safe even if the admission then sheds)
+        self._reclaim(w_need - m - n_dev, keep=keep)
+        fresh = [self._free.pop() for _ in range(w_need - m - n_dev)]
+        restored: list[tuple[int, int]] = []  # (ext index, blob bytes)
+        host_items = [
+            (i, nk, j) for i, (kind, nk, j) in enumerate(ext)
+            if kind == "host"
+        ]
+        if host_items:
+            try:
+                ext, fresh, restored = self._try_restore(
+                    arr, ext, host_items, fresh, keep
+                )
+            except PageExhaustedError:
+                self._free.extend(fresh)  # all-or-nothing still holds
+                raise
+        n_host = sum(1 for kind, _, _ in ext if kind == "host")
+        # Commit. Shared + re-linked pages gain a reference; fresh
+        # pages (restore targets included) are owned by this slot.
+        relinked = [page for kind, _, page in ext if kind == "dev"]
+        for page in shared + relinked:
             if self._ref[page] == 0:
                 self._stamp.pop(page, None)  # no longer evictable
             self._ref[page] += 1
+        for page in relinked:
+            # Re-attach the orphan to its parent's children set (the
+            # CoW probe's edge list — dropped when the parent was
+            # demoted; the re-link proves the linkage again).
+            self._children.setdefault(self._parent_of[page], set()).add(
+                page
+            )
         for page in fresh:
             self._ref[page] = 1
+        # Index restored pages at refcount > 0: from here on they are
+        # ordinary shared device pages riding the proven sharing path
+        # (free_slot parks them as evictable cache like any other).
+        for i, blob_len in restored:
+            _kind, nk, j = ext[i]
+            dst = fresh[sum(1 for q, _ in restored if q < i)]
+            parent = break_key if i == 0 else ext[i - 1][1]
+            self._index[nk] = dst
+            self._key_of[dst] = nk
+            self._tokens_of[dst] = arr[j * p:(j + 1) * p].copy()
+            self._parent_of[dst] = parent
+            self._children.setdefault(parent, set()).add(dst)
+            self.host_restores += 1
+            self.host_bytes_restored += blob_len
+        # Build the slot's row: shared, then the extension (re-linked
+        # device pages and restore targets in chain order), then the
+        # exclusive tail.
+        prefix_pages = list(shared)
+        fi = 0
+        for kind, _nk, x in ext:
+            if kind == "dev":
+                prefix_pages.append(int(x))
+            else:
+                prefix_pages.append(fresh[fi])
+                fi += 1
+        t = len(prefix_pages)  # == m + len(ext)
         row = self.tables[slot]
         row[:] = self.sentinel
-        row[:m] = shared
-        row[m:w_need] = fresh
+        row[:t] = prefix_pages
+        row[t:w_need] = fresh[n_host:]
+        if ext:
+            # The divergence moved past the original break: re-probe
+            # the CoW source among the FINAL key's children.
+            cow_page, cow_t = self._probe_cow(
+                ext[-1][1], arr[t * p: min(limit, (t + 1) * p)]
+            )
         gather = row.copy()
         if cow_page >= 0 and cow_t > 0:
-            gather[m] = cow_page
+            gather[t] = cow_page
             self.cow_copies += 1
         self.pages_admitted += w_need
-        self.pages_reused += m
-        if m or cow_t:
+        self.pages_reused += m + len(relinked)
+        if t or cow_t:
             self.hits += 1
         elif share:
             self.misses += 1
         return PageAdmission(
-            merge_start=m * p,
-            scan_start=m * p + cow_t,
+            merge_start=t * p,
+            scan_start=t * p + cow_t,
             gather_row=gather,
-            pages_shared=m,
+            pages_shared=t,
+            pages_restored=n_host,
         )
+
+    def _extend_lookup(
+        self, arr: np.ndarray, limit: int, m: int, key: int
+    ) -> list[tuple[str, int, int]]:
+        """Walk the chain past the device-resident break. A key still
+        in the device index is an ORPHANED page — its ancestor was
+        evicted, so _lookup can't reach it, but the cumulative chain
+        key plus content verification proves it — and re-links for
+        free. A key the host pool holds restores with one H2D. Stops
+        at the first key neither tier has. Returns chain-ordered
+        [("dev", key, page) | ("host", key, prompt_page_j)]."""
+        p = self.page_size
+        ext: list[tuple[str, int, int]] = []
+        for j in range(m, limit // p):
+            toks = arr[j * p:(j + 1) * p]
+            nk = self._chain(key, toks)
+            page = self._index.get(nk)
+            if page is not None and np.array_equal(
+                self._tokens_of[page], toks
+            ):
+                ext.append(("dev", nk, page))
+            elif self.host.has(nk, toks):
+                ext.append(("host", nk, j))
+            else:
+                break
+            key = nk
+        return ext
+
+    def _try_restore(
+        self,
+        arr: np.ndarray,
+        ext: list[tuple[str, int, int]],
+        host_items: list[tuple[int, int, int]],
+        fresh: list[int],
+        keep: frozenset,
+    ) -> tuple[list, list, list[tuple[int, int]]]:
+        """Attempt the admission's restore set as ONE batched H2D
+        write into the first len(host_items) fresh pages. On any
+        failure (host_restore_fail chaos included) degrade TYPED to
+        recompute: truncate the extension at the first host item —
+        later re-links would leave a chain gap — and top the fresh
+        set up to cover the dropped pages. Returns (final ext, final
+        fresh, [(ext index, blob bytes)] for restored items)."""
+        p = self.page_size
+        dst = fresh[:len(host_items)]
+        blobs: list[bytes] = []
+        ok = True
+        for _i, nk, j in host_items:
+            blob = self.host.get(nk, arr[j * p:(j + 1) * p])
+            if blob is None:  # pool raced/invalidated: same degradation
+                ok = False
+                break
+            blobs.append(blob)
+        if ok:
+            try:
+                self._restore_pages(dst, blobs)
+            except Exception as exc:  # noqa: BLE001 — typed degrade
+                ok = False
+                logger.warning(
+                    "host-tier restore failed (H2D), degrading to "
+                    "recompute: %s", exc,
+                )
+        if ok:
+            return ext, fresh, [
+                (i, len(blob)) for (i, _nk, _j), blob in zip(
+                    host_items, blobs
+                )
+            ]
+        self.host_restore_failures += 1
+        first = host_items[0][0]
+        dropped = [
+            page for kind, _, page in ext[first:] if kind == "dev"
+        ]
+        if dropped:
+            # Dropped re-links are evictable again — only the kept
+            # prefix still needs protecting from victim selection.
+            self._reclaim(
+                len(dropped), keep=keep - frozenset(dropped)
+            )  # may raise; the caller restores all-or-nothing
+            fresh = fresh + [
+                self._free.pop() for _ in range(len(dropped))
+            ]
+        return ext[:first], fresh, []
 
     def chain_pages(self, prompt: list) -> list[int]:
         """The indexed arena pages holding `prompt`'s full pages,
@@ -414,3 +709,47 @@ class PageAllocator:
         self._parent_of.clear()
         self._children.clear()
         self._stamp.clear()
+        # The host pool (if attached) deliberately SURVIVES a reset:
+        # its entries are host-RAM/file copies of pages that were valid
+        # when demoted — replays restore from it instead of recomputing
+        # the whole working set against the rebuilt arena.
+
+    def check_invariants(self) -> None:
+        """Exhaustive bookkeeping audit (test surface — the
+        eviction-racing-restore chaos suite calls this between every
+        interleaved step to prove zero pages are lost or double-mapped
+        through the serialized host-op stream). Raises AssertionError
+        naming the violated invariant."""
+        free = set(self._free)
+        assert len(free) == len(self._free), "duplicate page in free list"
+        for page in free:
+            assert self._ref[page] == 0, f"free page {page} has refs"
+            assert page not in self._key_of, f"free page {page} indexed"
+        live = self.tables[self.tables != self.sentinel]
+        counts = np.bincount(live, minlength=self.n_pages)
+        assert (counts == self._ref[:self.n_pages]).all(), (
+            "refcounts disagree with block-table occurrences"
+        )
+        for key, page in self._index.items():
+            assert self._key_of.get(page) == key, (
+                f"index/key_of disagree for page {page}"
+            )
+            assert page in self._tokens_of, f"indexed page {page} tokenless"
+            assert page not in free, f"indexed page {page} is free"
+        for page in self._stamp:
+            assert self._ref[page] == 0, f"stamped page {page} has refs"
+            assert page in self._key_of, f"stamped page {page} unindexed"
+        for page, key in self._key_of.items():
+            if self._ref[page] == 0:
+                assert page in self._stamp, (
+                    f"indexed refcount-0 page {page} unstamped (leak)"
+                )
+        # Conservation: every page is free, referenced, or cached.
+        cached = sum(
+            1 for page in self._key_of if self._ref[page] == 0
+        )
+        referenced = int((self._ref > 0).sum())
+        assert len(free) + referenced + cached == self.n_pages, (
+            f"pages lost: {len(free)} free + {referenced} live + "
+            f"{cached} cached != {self.n_pages}"
+        )

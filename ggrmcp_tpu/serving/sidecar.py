@@ -653,24 +653,29 @@ class Sidecar:
         sent_bytes = 0
         for start in range(0, n, per_chunk):
             end = min(n, start + per_chunk)
+            # The SHARED page-content codec (serving/tensors.py —
+            # also the host tier's storage format): one pack, two
+            # consumers, zero format drift.
+            payload = tensors.kv_pages_to_payload(
+                export["k"][:, start:end],
+                export["v"][:, start:end],
+                export["k_scale"][:, start:end] if quantized else None,
+                export["v_scale"][:, start:end] if quantized else None,
+            )
             chunk = serving_pb2.KVTransferRequest(
                 prompt_ids=prompt,
                 page_size=export["page_size"],
                 start_page=start,
                 total_pages=n,
-                k_pages=tensors.to_proto(export["k"][:, start:end]),
-                v_pages=tensors.to_proto(export["v"][:, start:end]),
+                k_pages=payload.k,
+                v_pages=payload.v,
                 kv_dtype=self.serving.kv_cache_dtype,
                 model_id=self.generation.cfg.name,
                 done=end == n,
             )
             if quantized:
-                chunk.k_scales.CopyFrom(
-                    tensors.to_proto(export["k_scale"][:, start:end])
-                )
-                chunk.v_scales.CopyFrom(
-                    tensors.to_proto(export["v_scale"][:, start:end])
-                )
+                chunk.k_scales.CopyFrom(payload.k_scales)
+                chunk.v_scales.CopyFrom(payload.v_scales)
             sent_bytes += chunk.ByteSize()
             await call(chunk, timeout=30.0)
         return n, sent_bytes
@@ -789,16 +794,13 @@ class Sidecar:
                 f"page size mismatch: sender {request.page_size} vs "
                 f"receiver {batcher._page_size}",
             )
-        k = tensors.from_proto(request.k_pages)
-        v = tensors.from_proto(request.v_pages)
-        k_scale = (
-            tensors.from_proto(request.k_scales)
-            if request.HasField("k_scales") else None
+        payload = serving_pb2.KVPagePayload(
+            k=request.k_pages, v=request.v_pages
         )
-        v_scale = (
-            tensors.from_proto(request.v_scales)
-            if request.HasField("v_scales") else None
-        )
+        if request.HasField("k_scales"):
+            payload.k_scales.CopyFrom(request.k_scales)
+            payload.v_scales.CopyFrom(request.v_scales)
+        k, v, k_scale, v_scale = tensors.kv_pages_from_payload(payload)
         prompt = list(request.prompt_ids)
         start = int(request.start_page)
         try:
@@ -1063,10 +1065,32 @@ class Sidecar:
                     component=comp, scope=scope, bytes=int(b)
                 ))
                 total += int(b)
+        # Host-tier components (ledger.register_host — the host-RAM
+        # complement of the device closure above; exact by
+        # construction, no reconcile pass): the GET /debug/memory
+        # `host` section.
+        host_components: list = []
+        host_total = 0
+        if ledger is not None and ledger.enabled:
+            for (scope, comp), info in sorted(
+                ledger.host_components().items()
+            ):
+                host_components.append(serving_pb2.HostMemoryComponent(
+                    component=comp, scope=scope,
+                    bytes=int(info.get("bytes", 0)),
+                    entries=int(info.get("entries", 0)),
+                    budget_bytes=int(info.get("budget_bytes", 0)),
+                    file_path=str(info.get("file_path", "")),
+                    file_bytes=int(info.get("file_bytes", 0)),
+                    file_entries=int(info.get("file_entries", 0)),
+                ))
+                host_total += int(info.get("bytes", 0))
         cstats = watcher.stats()
         return serving_pb2.MemoryResponse(
             components=components,
             total_bytes=total,
+            host=host_components,
+            host_total_bytes=host_total,
             live_bytes=live,
             unattributed_bytes=unattr_bytes,
             unattributed_arrays=unattr_arrays,

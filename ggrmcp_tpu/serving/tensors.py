@@ -8,6 +8,8 @@ may use repeated fields (JSON-friendly).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 from ggrmcp_tpu.rpc.pb import serving_pb2
@@ -63,3 +65,72 @@ def from_proto(proto: serving_pb2.Tensor) -> np.ndarray:
             shape if shape else (len(proto.float_values),)
         )
     return np.zeros(shape, dtype=_DTYPES.get(proto.dtype) or np.float32)
+
+
+# ---------------------------------------------------------------------
+# KV page-content codec — ONE pack/unpack for every consumer that moves
+# page KV through host memory: the TransferKV wire chunks
+# (sidecar→sidecar page shipping) and the host-tier page pool
+# (serving/host_pool.py demote/restore, including its mmap'd file
+# tier). Both ride serving_pb2.KVPagePayload built from to_proto /
+# from_proto above, so the two paths cannot drift in format — int8
+# scales included (round-trip bit-identity is regression-tested in
+# tests/test_host_pool.py).
+# ---------------------------------------------------------------------
+
+
+def kv_pages_to_payload(
+    k: np.ndarray,
+    v: np.ndarray,
+    k_scale: Optional[np.ndarray] = None,
+    v_scale: Optional[np.ndarray] = None,
+) -> serving_pb2.KVPagePayload:
+    """[L, n, P, KVH, Dh] K/V page arrays (+ int8 scales) → the shared
+    page-content proto. int8 KV MUST carry both scales; mixing is a
+    caller bug, surfaced here rather than as a garbled unpack."""
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError(
+            "int8 KV pages need BOTH k_scale and v_scale (or neither)"
+        )
+    payload = serving_pb2.KVPagePayload(k=to_proto(k), v=to_proto(v))
+    if k_scale is not None:
+        payload.k_scales.CopyFrom(to_proto(k_scale))
+        payload.v_scales.CopyFrom(to_proto(v_scale))
+    return payload
+
+
+def kv_pages_from_payload(
+    payload: serving_pb2.KVPagePayload,
+) -> tuple[
+    np.ndarray, np.ndarray, Optional[np.ndarray], Optional[np.ndarray]
+]:
+    """Inverse of kv_pages_to_payload: (k, v, k_scale, v_scale) with
+    scales None for unquantized KV."""
+    k = from_proto(payload.k)
+    v = from_proto(payload.v)
+    if payload.HasField("k_scales"):
+        return k, v, from_proto(payload.k_scales), from_proto(
+            payload.v_scales
+        )
+    return k, v, None, None
+
+
+def pack_kv_pages(
+    k: np.ndarray,
+    v: np.ndarray,
+    k_scale: Optional[np.ndarray] = None,
+    v_scale: Optional[np.ndarray] = None,
+) -> bytes:
+    """Serialized KVPagePayload — the host pool's storage format (RAM
+    entries and file-tier records hold exactly these bytes)."""
+    return kv_pages_to_payload(k, v, k_scale, v_scale).SerializeToString()
+
+
+def unpack_kv_pages(
+    blob: bytes,
+) -> tuple[
+    np.ndarray, np.ndarray, Optional[np.ndarray], Optional[np.ndarray]
+]:
+    payload = serving_pb2.KVPagePayload()
+    payload.ParseFromString(blob)
+    return kv_pages_from_payload(payload)

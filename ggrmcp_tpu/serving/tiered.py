@@ -55,6 +55,16 @@ class TieredBatcher:
         # auto-size to slots × max_seq / page_size.
         paged = getattr(cfg, "paged_kv", "off") == "on"
         budget = int(getattr(cfg, "paged_kv_pages", 0) or 0)
+        # The host tier's byte budget splits across tiers by the same
+        # volume proportion (each tier owns an independent HostPagePool
+        # — tiers share no mutable host state), and each tier's file
+        # log gets its own suffixed path so warm restarts re-map
+        # tier-for-tier.
+        host_budget = int(getattr(cfg, "paged_kv_host_bytes", 0) or 0)
+        host_path = getattr(cfg, "paged_kv_host_path", "") or ""
+        host_file_budget = int(
+            getattr(cfg, "paged_kv_host_file_bytes", 0) or 0
+        )
         volumes = [int(t[0]) * int(t[1]) for t in cfg.kv_tiers]
         total_volume = sum(volumes) or 1
         for tier, volume in zip(cfg.kv_tiers, volumes):
@@ -76,6 +86,18 @@ class TieredBatcher:
                 paged_kv_pages=(
                     max(1, budget * volume // total_volume)
                     if paged and budget else 0
+                ),
+                paged_kv_host_bytes=(
+                    max(1, host_budget * volume // total_volume)
+                    if paged and host_budget else 0
+                ),
+                paged_kv_host_path=(
+                    f"{host_path}.tier-{int(max_seq)}"
+                    if paged and host_budget and host_path else ""
+                ),
+                paged_kv_host_file_bytes=(
+                    max(1, host_file_budget * volume // total_volume)
+                    if paged and host_budget and host_file_budget else 0
                 ),
             )
             # The ledger scope matches the flight-recorder source

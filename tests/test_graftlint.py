@@ -382,6 +382,53 @@ class TestLedgerUnregistered:
         assert report.clean
         assert len(report.suppressed) == 1
 
+    # ISSUE 14 extension: host-pool buffers are byte-budgeted HOST
+    # memory — outside jax.live_arrays(), so reconcile() can never
+    # catch an unregistered pool. The rule's static complement covers
+    # them: a HostPagePool on self must be readable by a
+    # ledger.register_host supplier.
+    def test_fires_on_unregistered_host_pool(self, tmp_path):
+        report = lint(
+            tmp_path, "ggrmcp_tpu/serving/batching.py", """
+            from ggrmcp_tpu.serving.host_pool import HostPagePool
+
+            class Batcher:
+                def __init__(self, engine):
+                    self.host_pool = HostPagePool(1 << 20)
+            """,
+        )
+        assert rule_ids(report) == ["ledger-unregistered"]
+        assert "self.host_pool" in report.findings[0].message
+
+    def test_register_host_supplier_passes(self, tmp_path):
+        report = lint(
+            tmp_path, "ggrmcp_tpu/serving/batching.py", """
+            from ggrmcp_tpu.serving.host_pool import HostPagePool
+
+            class Batcher:
+                def __init__(self, engine):
+                    self.host_pool = HostPagePool(1 << 20)
+                    engine.ledger.register_host(
+                        "host_pool",
+                        lambda: self.host_pool.memory_info(),
+                    )
+            """,
+        )
+        assert report.clean
+
+    def test_host_pool_pragma_suppresses(self, tmp_path):
+        report = lint(
+            tmp_path, "ggrmcp_tpu/serving/scratch.py", """
+            from ggrmcp_tpu.serving.host_pool import HostPagePool
+
+            class Bench:
+                def __init__(self):
+                    self.pool = HostPagePool(1 << 20)  # graftlint: disable=ledger-unregistered -- fixture: bench-local pool, process exits after the phase
+            """,
+        )
+        assert report.clean
+        assert len(report.suppressed) == 1
+
 
 # ---------------------------------------------------------------------
 # 1d. async-hygiene (PR 2: swallowed CancelledError)
