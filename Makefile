@@ -7,8 +7,8 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: proto proto-check descriptors test test-all test-fast test-chaos \
   test-obs test-grammar test-spec-batch test-paged test-tp test-analysis \
-  test-disagg test-fleet test-mem test-kvtier bench-cpu smoke e2e lint graftlint \
-  ci-local preflight clean
+  test-disagg test-fleet test-mem test-kvtier test-lora-arena bench-cpu \
+  smoke e2e lint graftlint ci-local preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
 # No protoc on this image? scripts/regen_serving_pb2.py regenerates
@@ -158,6 +158,15 @@ test-mem:
 # pages.py host-tier work.
 test-kvtier:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m kvtier
+
+# Dynamic LoRA adapter arena alone (CPU mesh): registry residency/
+# refcount/LRU units, mid-run adapter discovery with zero recompiles,
+# mixed-vs-serial greedy bit-identity (1-chip + 2-device mesh, paged
+# and contiguous), adapter-keyed page-chain domain separation,
+# adapter_load_fail chaos, gateway per-tool binding. Tier-1 runs these
+# too; this target is the fast inner loop for multi-tenant LoRA work.
+test-lora-arena:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m lora_arena
 
 # ruff if present (baked CI image installs it; the TPU image may not).
 lint:

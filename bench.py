@@ -91,6 +91,20 @@ Environment knobs:
                          per-page restore-vs-recompute crossover is
                          scripts/bench_kv_restore.py (own artifact,
                          ready to re-run on-chip).
+  GGRMCP_BENCH_LORA      multi-LoRA adapter-arena phase ("on" by
+                         default off-TPU, "off" skips): N registry
+                         adapters x M sessions each — ONE mixed-
+                         adapter continuous batch vs the serial
+                         per-adapter baseline (aggregate tokens/s
+                         uplift), per-adapter TTFT p99 and the
+                         fairness spread across adapters, plus a
+                         CHURN variant with the arena working set at
+                         ~N/3 rows reporting loads/evictions and the
+                         arena hit rate (lora_* extras;
+                         docs/multi_lora.md). Knobs:
+                         GGRMCP_BENCH_LORA_ADAPTERS (8),
+                         GGRMCP_BENCH_LORA_SESSIONS (2 per adapter),
+                         GGRMCP_BENCH_LORA_CALLS (2 per session).
   GGRMCP_BENCH_REPLICAS=N  N-replica routing phase (standalone mode,
                          like PROXY_ONLY): spins N paged-KV sidecar
                          replica PROCESSES behind one gateway and
@@ -1379,6 +1393,21 @@ async def _run_bench() -> dict:
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: kvtier phase failed: {exc!r}", file=sys.stderr)
 
+    # Multi-LoRA adapter arena (GGRMCP_BENCH_LORA, docs/multi_lora.md):
+    # same isolation rationale — runs after the serving stack is down,
+    # on its own arena-mode engine.
+    lora = {}
+    want_lora = os.environ.get("GGRMCP_BENCH_LORA")
+    if want_lora == "on" or (
+        want_lora is None and not headline_only and not on_tpu
+    ):
+        try:
+            lora = await _lora_bench(
+                model, max_new, tick_steps, quantize, kv_dtype, synth,
+            )
+        except Exception as exc:  # secondary phase must not sink the run
+            print(f"bench: lora phase failed: {exc!r}", file=sys.stderr)
+
     # Tensor-parallel serving A/B (GGRMCP_BENCH_TP,
     # docs/tensor_parallel_serving.md): same isolation rationale —
     # runs after the serving stack is down, on its own engines.
@@ -1403,9 +1432,196 @@ async def _run_bench() -> dict:
             print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
     return {
         **headline, **hbm, **obs_export, **prefix, **longp, **mixed,
-        **grammar, **ticktime, **specbatch, **paged, **kvtier, **tp,
-        **proxy,
+        **grammar, **ticktime, **specbatch, **paged, **kvtier, **lora,
+        **tp, **proxy,
     }
+
+
+async def _lora_bench(
+    model: str, max_new: int, tick_steps, quantize: str, kv_dtype: str,
+    synth: bool,
+) -> dict:
+    """Multi-LoRA adapter-arena phase (docs/multi_lora.md): N registry
+    adapters × M sessions each, driven three ways on the same dynamic-
+    arena engine —
+
+    1. MIXED: every session concurrent, heterogeneous adapters in one
+       continuous batch (the S-LoRA shape this PR exists for) —
+       aggregate tokens/s + per-adapter TTFT p99 (fairness spread).
+    2. SERIAL baseline: one adapter's sessions at a time (the
+       bucketing/batch-splitting strawman a non-heterogeneous batcher
+       forces) — same total work, tokens/s from summed wall time.
+    3. CHURN: the mixed workload against an arena of ~N/3 rows, so
+       adapters page in and out under load — loads/evictions and the
+       arena hit rate (hits / (hits + loads)).
+
+    Adapters are REAL registry files (random factors written to a
+    tempdir, loaded H2D on first sighting — the load cost is in the
+    numbers, not hidden by preloading)."""
+    import asyncio as _asyncio
+    import tempfile
+
+    import numpy as np
+
+    from ggrmcp_tpu.core.config import (
+        BatchingConfig, LoraConfig, MeshConfig, ObservabilityConfig,
+        ServingConfig,
+    )
+    from ggrmcp_tpu.models import get_model
+    from ggrmcp_tpu.ops.sampling import SamplingConfig
+    from ggrmcp_tpu.serving.batching import ContinuousBatcher
+    from ggrmcp_tpu.serving.engine import GenerationEngine
+    from ggrmcp_tpu.utils.stats import pct
+
+    n_adapters = int(os.environ.get("GGRMCP_BENCH_LORA_ADAPTERS", "8"))
+    sessions = int(os.environ.get("GGRMCP_BENCH_LORA_SESSIONS", "2"))
+    calls = int(os.environ.get("GGRMCP_BENCH_LORA_CALLS", "2"))
+    budget = max(8, max_new)
+    _, mcfg = get_model(model)
+    rank = 4
+    qkv_out = (
+        mcfg.num_heads + 2 * mcfg.num_kv_heads
+    ) * mcfg.head_dim
+    registry = tempfile.mkdtemp(prefix="ggrmcp-lora-bench-")
+    rng = np.random.default_rng(0)
+    names = [f"tenant{i:03d}" for i in range(n_adapters)]
+    for name in names:
+        np.savez(
+            os.path.join(registry, f"{name}.npz"),
+            a=rng.normal(0, 0.02, (mcfg.num_layers, mcfg.hidden_dim, rank)),
+            b=rng.normal(0, 0.02, (mcfg.num_layers, rank, qkv_out)),
+        )
+    greedy = SamplingConfig(temperature=0.0)
+    loop = _asyncio.get_running_loop()
+
+    def build(rows: int):
+        engine = GenerationEngine(mcfg, ServingConfig(
+            model=model, quantize=quantize, kv_cache_dtype=kv_dtype,
+            synthetic_weights=synth, mesh=MeshConfig(),
+            observability=ObservabilityConfig(enabled=False),
+            lora=LoraConfig(registry=registry, rank=rank,
+                            arena_rows=rows),
+        ))
+        return engine, ContinuousBatcher(engine, BatchingConfig(
+            max_batch_size=8, kv_cache_max_seq=512,
+            decode_steps_per_tick=tick_steps,
+        ))
+
+    from ggrmcp_tpu.serving.adapter_arena import AdapterExhaustedError
+
+    async def run_session(batcher, adapter: str, s: int, ttfts: list):
+        tokens = 0
+        for c in range(calls):
+            while True:
+                try:
+                    lease = await batcher.acquire_adapter(adapter)
+                    break
+                except AdapterExhaustedError:
+                    # The typed 429 a real client sees under churn —
+                    # back off and retry (the shed count rides the
+                    # artifact via lora_shed).
+                    await _asyncio.sleep(0.02)
+            prompt = [
+                3 + (hash((adapter, s, c, i)) % 200)
+                for i in range(4)
+            ]
+            t0 = time.perf_counter()
+            first = None
+            async for ids, _reason in batcher.submit(
+                prompt, budget, greedy, seed=s * 131 + c,
+                adapter=lease.row, adapter_key=adapter,
+                adapter_lease=lease,
+            ):
+                if first is None and ids:
+                    first = (time.perf_counter() - t0) * 1000.0
+                tokens += len(ids)
+            ttfts.append((adapter, first or 0.0))
+        return tokens
+
+    async def drive(batcher, mode: str):
+        """(tokens, elapsed_s, per-adapter ttfts) for one workload."""
+        ttfts: list = []
+        t0 = time.perf_counter()
+        if mode == "mixed":
+            totals = await _asyncio.gather(*(
+                run_session(batcher, name, s, ttfts)
+                for name in names for s in range(sessions)
+            ))
+            return sum(totals), time.perf_counter() - t0, ttfts
+        tokens = 0
+        for name in names:  # serial per-adapter baseline
+            totals = await _asyncio.gather(*(
+                run_session(batcher, name, s, ttfts)
+                for s in range(sessions)
+            ))
+            tokens += sum(totals)
+        return tokens, time.perf_counter() - t0, ttfts
+
+    out: dict = {
+        "lora_adapters": n_adapters,
+        "lora_sessions_per_adapter": sessions,
+        "lora_calls_per_session": calls,
+    }
+    engine, batcher = build(rows=n_adapters)
+    await loop.run_in_executor(None, batcher.warmup)
+    batcher.start()
+    try:
+        # one throwaway call absorbs first-dispatch compile noise
+        await run_session(batcher, names[0], 999, [])
+        tokens, elapsed, ttfts = await drive(batcher, "mixed")
+        per_adapter = {
+            name: pct([t for a, t in ttfts if a == name], 0.99)
+            for name in names
+        }
+        p99s = list(per_adapter.values())
+        out["lora_mixed_tokens_per_sec"] = round(tokens / elapsed, 2)
+        out["lora_ttft_p99_per_adapter_ms"] = per_adapter
+        out["lora_ttft_p99_spread_ms"] = round(max(p99s) - min(p99s), 2)
+        s_tokens, s_elapsed, _ = await drive(batcher, "serial")
+        out["lora_serial_tokens_per_sec"] = round(s_tokens / s_elapsed, 2)
+        out["lora_mixed_uplift"] = round(
+            out["lora_mixed_tokens_per_sec"]
+            / max(out["lora_serial_tokens_per_sec"], 1e-9), 3,
+        )
+        out.update(engine.lora_stats())
+    finally:
+        await batcher.stop()
+
+    # Churn variant: working set ~N/3 rows — adapters page in and out.
+    churn_rows = max(1, n_adapters // 3)
+    engine_c, batcher_c = build(rows=churn_rows)
+    await loop.run_in_executor(None, batcher_c.warmup)
+    batcher_c.start()
+    try:
+        c_tokens, c_elapsed, _ = await drive(batcher_c, "mixed")
+        stats = engine_c.lora_stats()
+        loads, hits = stats["lora_loads"], stats["lora_hits"]
+        out["lora_churn"] = {
+            "arena_rows": churn_rows,
+            "tokens_per_sec": round(c_tokens / c_elapsed, 2),
+            "loads": loads,
+            "evictions": stats["lora_evictions"],
+            "hit_rate": round(hits / max(hits + loads, 1), 4),
+            "load_ms_total": stats["lora_load_ms"],
+        }
+    finally:
+        await batcher_c.stop()
+    # Reviewable artifact beside fleet_trace.json: the full phase
+    # result (per-adapter p99 table included — the main artifact only
+    # carries the headline keys comfortably).
+    try:
+        art_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_artifacts"
+        )
+        os.makedirs(art_dir, exist_ok=True)
+        with open(
+            os.path.join(art_dir, "lora_arena.json"), "w",
+            encoding="utf-8",
+        ) as fh:
+            json.dump(out, fh, indent=1, sort_keys=True)
+    except OSError as exc:  # artifact write must not sink the phase
+        print(f"bench: lora artifact write failed: {exc}", file=sys.stderr)
+    return out
 
 
 async def _tp_bench(

@@ -122,6 +122,7 @@ class HeaderForwardingConfig:
             "x-request-id",
             "x-user-id",
             "x-session-id",
+            "x-adapter-id",
             "x-api-key",
             "user-agent",
             "accept-language",
@@ -689,6 +690,14 @@ class GatewayConfig:
     # Callers can also pass `constraint.toolOutputSchemaRef` per call —
     # the gateway resolves it the same way.
     structured_output: dict = field(default_factory=dict)
+    # Per-MCP-tool settings: tool name → {"adapter": <name>}. The
+    # adapter binding injects `adapter=<name>` into every call of that
+    # tool whose input message carries an `adapter` field (the TPU
+    # Generate surface), so one pod serves a thousand fine-tunes
+    # behind one tool list (docs/multi_lora.md). Per-call/per-session
+    # override: the forwarded `x-adapter-id` header beats the binding;
+    # an explicit `adapter` argument beats both.
+    tools: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -799,15 +808,31 @@ class ServingConfig:
 
 @dataclass
 class LoraConfig:
-    # Adapter names; request field `adapter` selects one. Served ids
-    # are 1..N in list order (0 = the base model). Empty = LoRA off.
+    # BOOT-TIME adapter names; request field `adapter` selects one.
+    # Served ids are 1..N in list order (0 = the base model). Empty =
+    # LoRA off (unless `registry` is set — the dynamic mode below).
+    # Kept supported as the static migration path from PR-era configs;
+    # docs/multi_lora.md has the registry migration.
     adapters: list = field(default_factory=list)
     rank: int = 8  # low-rank dimension r (factors stored pre-scaled)
-    # Directory of trained factors, one `{name}.npz` per adapter with
-    # arrays `a` [L, D, r] and `b` [L, r, (H+2KVH)*Dh] (pre-scaled by
-    # alpha/r). Missing files leave that adapter a zero-init no-op;
-    # "" loads nothing.
+    # Directory of trained factors for the BOOT-TIME adapters, one
+    # `{name}.npz` per adapter with arrays `a` [L, D, r] and `b`
+    # [L, r, (H+2KVH)*Dh] (pre-scaled by alpha/r). Missing files leave
+    # that adapter a zero-init no-op; "" loads nothing.
     path: str = ""
+    # DYNAMIC adapter registry (serving/adapter_arena.py,
+    # docs/multi_lora.md): a directory of `{name}.npz` factor pairs,
+    # scanned at REQUEST time — dropping a new file serves a new
+    # tenant with no restart and no recompile. Adapter capacity is the
+    # registry, not HBM: only `arena_rows` adapters are device-resident
+    # at once (refcounted, LRU-evicted under churn; all-pinned sheds
+    # typed RESOURCE_EXHAUSTED). Mutually exclusive with `adapters`
+    # (the static list) — every adapter rides the arena in this mode.
+    registry: str = ""
+    # Device-resident adapter rows beside the reserved base row 0.
+    # HBM cost is arena_rows × L × r × (D + qkv_out) in the model
+    # dtype; the `lora` memory-ledger component reports the real bytes.
+    arena_rows: int = 8
 
 
 # ---------------------------------------------------------------------------
@@ -993,6 +1018,41 @@ class Config:
                 "gateway.structured_output must map tool names to "
                 "'self' (or '') or another tool name"
             )
+        tools_cfg = self.gateway.tools
+        if not isinstance(tools_cfg, dict):
+            raise ValueError(
+                "gateway.tools must map tool names to per-tool settings"
+            )
+        for tool, entry in tools_cfg.items():
+            if not isinstance(tool, str) or not isinstance(entry, dict):
+                raise ValueError(
+                    "gateway.tools must map tool names to settings dicts "
+                    "(e.g. {\"adapter\": \"acme\"})"
+                )
+            unknown = set(entry) - {"adapter"}
+            if unknown:
+                raise ValueError(
+                    f"gateway.tools[{tool!r}]: unknown keys "
+                    f"{sorted(unknown)}; supported: 'adapter'"
+                )
+            adapter = entry.get("adapter", "")
+            if not isinstance(adapter, str) or not adapter:
+                raise ValueError(
+                    f"gateway.tools[{tool!r}].adapter must be a "
+                    "non-empty adapter name"
+                )
+        lora = self.serving.lora
+        if lora.registry and lora.adapters:
+            raise ValueError(
+                "serving.lora.registry (dynamic arena) and lora.adapters "
+                "(boot-time list) are mutually exclusive — move the "
+                "static adapters' .npz files into the registry "
+                "(docs/multi_lora.md migration)"
+            )
+        if (lora.registry or lora.adapters) and lora.rank < 1:
+            raise ValueError("serving.lora.rank must be >= 1")
+        if lora.arena_rows < 1:
+            raise ValueError("serving.lora.arena_rows must be >= 1")
         routing = self.gateway.routing
         if routing.policy not in ROUTING_POLICIES:
             raise ValueError(

@@ -386,6 +386,50 @@ class MCPHandler:
         new_constraint["jsonSchema"] = json.dumps(schema)
         return {**arguments, "constraint": new_constraint}
 
+    def _apply_adapter_binding(
+        self, tool_name: str, arguments: Any, session: SessionContext
+    ) -> Any:
+        """Multi-tenant adapter binding (gateway.tools.<name>.adapter +
+        serving/adapter_arena.py, docs/multi_lora.md): resolve which
+        LoRA adapter — if any — this call decodes under, and inject it
+        as the `adapter` argument so one pod serves a thousand
+        fine-tunes behind one tool list.
+
+        Precedence, most explicit first: an `adapter` the caller
+        already passed in the arguments is untouched; the session's
+        forwarded `x-adapter-id` header overrides the operator's
+        per-tool binding; the binding is the default. Only tools whose
+        input message carries an `adapter` field (the TPU Generate
+        surface) are eligible — anything else passes through untouched
+        rather than failing proto transcoding. The injected value also
+        feeds the router's adapter-affinity key (rpc/router.py), so an
+        adapter's weights and pages stay co-resident on one replica."""
+        if not isinstance(arguments, dict) or arguments.get("adapter"):
+            return arguments
+        override = ""
+        for key, value in session.headers.items():
+            if key.lower() == "x-adapter-id" and value:
+                override = value[0] if isinstance(value, list) else value
+                break
+        gateway_cfg = getattr(self.cfg, "gateway", None)
+        bound = (
+            gateway_cfg.tools.get(tool_name, {}).get("adapter", "")
+            if gateway_cfg is not None and isinstance(
+                getattr(gateway_cfg, "tools", None), dict
+            ) else ""
+        )
+        name = override or bound
+        if not name:
+            return arguments
+        try:
+            method = self.discoverer.get_method_by_tool(tool_name)
+        except ToolNotFoundError:
+            return arguments  # invoke will surface the real error
+        desc = method.input_descriptor
+        if desc is None or "adapter" not in desc.fields_by_name:
+            return arguments  # binding on a non-generate tool: skip
+        return {**arguments, "adapter": name}
+
     async def _handle_tools_call(
         self,
         session: SessionContext,
@@ -393,6 +437,7 @@ class MCPHandler:
     ) -> dict[str, Any]:
         tool_name, arguments = self.validator.validate_tool_call_params(params)
         arguments = self._apply_structured_output(tool_name, arguments)
+        arguments = self._apply_adapter_binding(tool_name, arguments, session)
         headers = self._metadata_with_trace(session)
         start = time.perf_counter()
         try:
@@ -495,6 +540,7 @@ class MCPHandler:
         or the fast lane's raw socket writer)."""
         tool_name, arguments = self.validator.validate_tool_call_params(params)
         arguments = self._apply_structured_output(tool_name, arguments)
+        arguments = self._apply_adapter_binding(tool_name, arguments, session)
         headers = self._metadata_with_trace(session)
         await sse.start(session.id, trace_id)
         start = time.perf_counter()

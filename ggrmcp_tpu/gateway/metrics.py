@@ -194,6 +194,29 @@ _SERVING_HELP = {
     "kv_host_restore_failures":
         "admissions whose restore failed and degraded typed to "
         "recompute (bit-identical output, just slower)",
+    # Multi-LoRA adapter arena (serving/adapter_arena.py,
+    # docs/multi_lora.md): registry-backed dynamic adapters paged in
+    # and out of a fixed device working set.
+    # lora_hits / (lora_hits + lora_loads) is the arena hit rate;
+    # lora_adapters_resident vs lora_rows_total is the occupancy gauge.
+    "lora_adapters_registered":
+        "adapters discoverable in the disk registry (runtime scan — "
+        "no restart to add a tenant)",
+    "lora_adapters_resident":
+        "arena rows holding an adapter (pinned + LRU-cached)",
+    "lora_rows_total":
+        "device-resident adapter rows (serving.lora.arena_rows)",
+    "lora_loads":
+        "adapter factor loads from the registry (one batched H2D "
+        "write each, serialized between ticks)",
+    "lora_evictions": "refcount-0 adapter rows evicted under churn",
+    "lora_hits": "adapter acquisitions served by a resident row",
+    "lora_load_ms":
+        "cumulative adapter load wall time (disk read + H2D install, "
+        "ms)",
+    "lora_shed":
+        "adapter acquisitions shed typed with every row pinned "
+        "(RESOURCE_EXHAUSTED -> HTTP 429)",
 }
 
 _SERVING_HIST_HELP = {

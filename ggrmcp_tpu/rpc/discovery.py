@@ -503,10 +503,12 @@ class ServiceDiscoverer:
             self.GENERATE_SERVICE_PREFIX
         ):
             return None
-        if arguments.get("adapter"):
-            # Adapter'd KV never enters shared page storage (the LoRA
-            # contamination rule), so there is nothing to ship.
-            return None
+        # Adapter'd calls disaggregate too since ISSUE 15: page chains
+        # are keyed per adapter domain (serving/pages.py adapter_root),
+        # the prefill leg runs under the request's adapter, and the
+        # TransferKV chunk carries the adapter name so the decode
+        # replica re-derives the same chain — the old "adapter'd KV
+        # never enters shared storage" skip is lifted.
         method, candidates = self._candidates(tool_name)
         if len(candidates) < 2:
             return None

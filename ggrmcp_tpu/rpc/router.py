@@ -111,12 +111,31 @@ def derive_affinity_key(
     headers: Optional[Sequence[tuple[str, str]]],
     preamble_bytes: int,
 ) -> Optional[bytes]:
-    """The stable routing key: the caller's ``x-session-id`` header when
-    present (explicit session pinning), else the tool name + the first N
-    bytes of the canonically serialized request (sorted-key JSON — the
-    shared system-prompt preamble lands in those bytes, so same-preamble
-    sessions share a key). None when no key can be derived (router falls
-    back to load-based placement)."""
+    """The stable routing key, strongest-cohort first: the call's LoRA
+    adapter id when one applies (the ``adapter`` argument the gateway
+    resolved from binding/header, else the forwarded ``x-adapter-id``)
+    — HRW on the adapter id keeps an adapter's arena row AND its
+    key-domain prefix pages co-resident on ONE replica, so a thousand
+    tenants cost one load each fleet-wide instead of one per replica
+    (docs/multi_lora.md; an overloaded home still spills, counted).
+    Then the caller's ``x-session-id`` header (explicit session
+    pinning), else the tool name + the first N bytes of the canonically
+    serialized request (sorted-key JSON — the shared system-prompt
+    preamble lands in those bytes, so same-preamble sessions share a
+    key). None when no key can be derived (router falls back to
+    load-based placement)."""
+    adapter = ""
+    if isinstance(arguments, dict):
+        value = arguments.get("adapter")
+        if isinstance(value, str):
+            adapter = value
+    if not adapter and headers:
+        for key, value in headers:
+            if key.lower() == "x-adapter-id" and value:
+                adapter = value
+                break
+    if adapter:
+        return b"a:" + adapter.encode("utf-8", "surrogatepass")
     if headers:
         for key, value in headers:
             if key.lower() == "x-session-id" and value:

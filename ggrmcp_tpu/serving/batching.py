@@ -198,6 +198,16 @@ class _Request:
     absorbed: int = 0
     # LoRA adapter row id (0 = base model; ops/lora.py).
     adapter: int = 0
+    # STABLE adapter identity for KV keying (the adapter NAME, "" =
+    # base): arena rows are reused after eviction, so page hash-chain
+    # domains key on this, never on the row id (serving/pages.py).
+    adapter_key: str = ""
+    # Arena residency pin (serving/adapter_arena.py AdapterLease, None
+    # = static mode or base row): held until the terminal chunk —
+    # _record_terminal releases it on every terminal path, exactly
+    # like the grammar handle — so churn eviction can never rewrite a
+    # row an in-flight request is decoding under.
+    adapter_lease: object = None
     # Latency accounting (perf_counter seconds): submit → activation
     # is queue time, activation → terminal chunk is service time.
     t_submit: float = 0.0
@@ -238,6 +248,12 @@ class ContinuousBatcher:
         # values reports them once instead of summing a constant per
         # tier (the per-tier components below them sum as usual).
         "memory_weights_bytes", "memory_lora_bytes",
+        # Adapter-arena counters are ENGINE-level (one arena per
+        # process, every tier resolves against it): max of identical
+        # snapshots, never a per-tier sum of the same counter.
+        "lora_adapters_registered", "lora_adapters_resident",
+        "lora_rows_total", "lora_loads", "lora_evictions", "lora_hits",
+        "lora_load_ms", "lora_shed",
     )
 
     def __init__(
@@ -828,7 +844,9 @@ class ContinuousBatcher:
         )
         return clamped
 
-    def export_prompt_kv(self, prompt: list[int]) -> dict:
+    def export_prompt_kv(
+        self, prompt: list[int], adapter: str = ""
+    ) -> dict:
         """Gather the indexed full-page KV of `prompt` from the device
         arena to host (the prefill-role half of disaggregated serving;
         run via run_host_op — the serialized executor stream is what
@@ -837,12 +855,14 @@ class ContinuousBatcher:
         KVH, Dh] host arrays (int8 KV ships values + scales — half the
         bytes). Raises KVTransferError when paging is off or the index
         holds no pages for this prompt (evicted, or never admitted):
-        the caller degrades typed, never ships a lie."""
+        the caller degrades typed, never ships a lie. `adapter`: the
+        stable adapter key the chain was registered under ("" = base)
+        — adapter'd prompts export their own key domain's pages."""
         if not self._paged:
             raise KVTransferError(
                 "kv export requires batching.paged_kv=on"
             )
-        pages = self.pages.chain_pages(prompt)
+        pages = self.pages.chain_pages(prompt, adapter=adapter)
         if not pages:
             raise KVTransferError(
                 "no indexed pages for this prompt (evicted before "
@@ -866,6 +886,7 @@ class ContinuousBatcher:
         v: np.ndarray,
         k_scale: "Optional[np.ndarray]" = None,
         v_scale: "Optional[np.ndarray]" = None,
+        adapter: str = "",
     ) -> tuple[int, int]:
         """Land one TransferKV chunk in this batcher's arena (the
         decode-role half; run via run_host_op): allocate + index the
@@ -897,7 +918,7 @@ class ContinuousBatcher:
                 f"{want} (layers, page_size, kv_heads, head_dim)"
             )
         placed = self.pages.import_chain(
-            prompt, start_page, int(k.shape[1])
+            prompt, start_page, int(k.shape[1]), adapter=adapter
         )
         present = int(k.shape[1]) - len(placed)
         if not placed:
@@ -1859,13 +1880,17 @@ class ContinuousBatcher:
         self.adapter_ids[slot_idx] = request.adapter
         # Paged KV: the prompt's full pages now hold valid prefix KV
         # (activation implies the prefill materialized) — index them so
-        # later admissions share instead of recomputing. BASE rows only:
-        # adapter'd K/V must never enter shared storage (same rule as
-        # the slot-granular pool). Before _emit: a one-token request
-        # finishes inside it, and the cache window should survive the
-        # request (refcount-0 indexed pages stay resident, LRU-evicted).
-        if self._paged and request.adapter == 0:
-            self.pages.register(slot_idx, request.prompt)
+        # later admissions share instead of recomputing. Adapter'd rows
+        # index under their own key domain (the chain root folds the
+        # stable adapter key — serving/pages.py), so same-adapter
+        # sessions share while cross-adapter aliasing stays impossible.
+        # Before _emit: a one-token request finishes inside it, and the
+        # cache window should survive the request (refcount-0 indexed
+        # pages stay resident, LRU-evicted).
+        if self._paged:
+            self.pages.register(
+                slot_idx, request.prompt, adapter=request.adapter_key
+            )
         self._emit(slot_idx, first_tok)
 
     # -- public API ---------------------------------------------------------
@@ -2186,6 +2211,32 @@ class ContinuousBatcher:
         if self.host_pool is not None:
             self.host_pool.close()
 
+    async def acquire_adapter(self, name: str):
+        """Resolve an adapter NAME to a pinned arena row (dynamic-
+        registry mode, serving/adapter_arena.py) — the load's batched
+        H2D factor write runs through the serialized run_host_op
+        stream BETWEEN ticks, never racing a dispatch. Returns the
+        AdapterLease; pass it (and the name, as adapter_key) to
+        submit(), which releases it on every terminal path. Typed
+        failures propagate: UnknownAdapterError (caller's error),
+        AdapterExhaustedError (overload ladder), AdapterLoadError
+        (degrade loudly — never silently serve base weights)."""
+        arena = getattr(self.engine, "adapter_arena", None)
+        if arena is None:
+            raise RuntimeError(
+                "no dynamic adapter arena (serving.lora.registry unset); "
+                "resolve names via engine.resolve_adapter"
+            )
+        return await self.run_host_op(lambda: arena.acquire(name))
+
+    def release_adapter(self, lease) -> None:
+        """Return an acquired lease that never reached submit() (shed/
+        validation failures on the caller's side). Host bookkeeping
+        only — safe from the loop thread, idempotent like the
+        in-request release."""
+        if lease is not None:
+            self.engine.adapter_arena.release(lease)
+
     async def run_host_op(self, fn):
         """Run `fn()` (host + device work) in the batcher's serialized
         executor stream — between ticks and admission rounds, never
@@ -2229,13 +2280,19 @@ class ContinuousBatcher:
         adapter: int = 0,
         trace_id: str = "",
         grammar: Optional[CompiledGrammar] = None,
+        adapter_key: str = "",
+        adapter_lease=None,
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         """Enqueue a request; yields (token_ids_chunk, finish_reason)
         pairs; finish_reason is set on the final chunk. `unary=True`
         (non-streaming consumers): one terminal chunk with all tokens —
         same iterator contract, a fraction of the cross-thread events
         (see _Request.unary). `adapter`: LoRA adapter row id (0 = base;
-        resolve names via engine.resolve_adapter). `trace_id`: the
+        resolve names via engine.resolve_adapter, or acquire_adapter
+        under the dynamic arena — which also yields `adapter_lease`,
+        the residency pin this request holds until its terminal chunk,
+        and `adapter_key`, the stable name the paged-KV hash chains
+        key on). `trace_id`: the
         gateway trace this request serves — stamped into the flight
         recorder's request/tick records so one id walks span → request
         record → tick records. `grammar`: a CompiledGrammar
@@ -2258,11 +2315,32 @@ class ContinuousBatcher:
         # Range-check the adapter row (names resolve upstream):
         # jnp.take clips out-of-range gathers, which would silently
         # serve the WRONG adapter's factors.
-        n_adapters = len(getattr(self.engine, "lora_names", {}))
+        arena = getattr(self.engine, "adapter_arena", None)
+        n_adapters = (
+            arena.rows if arena is not None
+            else len(getattr(self.engine, "lora_names", {}))
+        )
         if not 0 <= adapter <= n_adapters:
             raise ValueError(
                 f"adapter id {adapter} out of range (0..{n_adapters})"
             )
+        if adapter and not adapter_key:
+            if adapter_lease is not None:
+                adapter_key = adapter_lease.name
+            elif arena is None:
+                # Static mode: rows are stable 1:1 with names, so a
+                # row-derived key is a valid stable domain for callers
+                # that skipped name resolution (direct batcher tests).
+                adapter_key = f"row:{adapter}"
+            else:
+                # Arena rows are REUSED after eviction — a row-derived
+                # key would alias one tenant's KV to another's. Name
+                # your adapter (acquire_adapter returns the lease).
+                raise ValueError(
+                    "dynamic adapter arena: submit needs adapter_key "
+                    "(or the AdapterLease from acquire_adapter) — row "
+                    "ids are not stable KV-keying identities"
+                )
         # Reserve cache positions for tick overshoot: a tick may run
         # past a slot's max_new by up to steps_per_tick-1 positions
         # before the host masks the extra tokens — one further full
@@ -2299,6 +2377,7 @@ class ContinuousBatcher:
             prompt=prompt, max_new=max_new, sampling=sampling, seed=seed,
             unary=unary, adapter=adapter, trace_id=trace_id,
             n_prompt=len(prompt), grammar=handle,
+            adapter_key=adapter_key, adapter_lease=adapter_lease,
         )
         request.t_submit = time.perf_counter()
         self.pending.put_nowait(request)
@@ -2456,6 +2535,13 @@ class ContinuousBatcher:
             # replication — 0 downgrades is what makes "TP serving" a
             # verified claim instead of a config setting.
             **self.engine.mesh_stats(),
+            # Multi-LoRA serving (ops/lora.py + serving/adapter_arena
+            # .py; all zeros when LoRA is off): registry size, rows
+            # resident/total, dynamic loads/evictions/hits, cumulative
+            # load wall time, and acquisitions shed typed when every
+            # row was pinned. hits/(hits+loads) is the arena hit rate
+            # the churn bench holds (docs/multi_lora.md).
+            **self.engine.lora_stats(),
             "active_slots": self._active_count(),
             "total_slots": len(self.slots),
             "queued_requests": self.pending.qsize(),
@@ -2612,8 +2698,12 @@ class ContinuousBatcher:
         timeout, replay exhaustion, cancellation, admission failure),
         so the request ring accounts for failures, not only successes.
         Doubles as the one place a terminal request returns its grammar
-        arena reference (same every-path property)."""
+        arena reference AND its adapter-arena lease (same every-path
+        property — a leaked pin would exempt a row from eviction
+        forever)."""
         self._grammar_release(request)
+        if request.adapter_lease is not None:
+            self.engine.adapter_arena.release(request.adapter_lease)
         if not self.recorder.enabled:
             return
         if request.first_tick >= 0:
@@ -2982,10 +3072,17 @@ class ContinuousBatcher:
                     # Chaos hook: page_exhausted forces the allocator's
                     # exhaustion path (utils/failpoints.py).
                     failpoints.evaluate("page_exhausted")
+                    # Sharing is adapter-DOMAIN-scoped since ISSUE 15:
+                    # the chain root folds the stable adapter key, so
+                    # same-adapter sessions share prefix pages (and
+                    # ride the host tier) while cross-adapter sharing
+                    # is impossible by key construction — the old
+                    # `share=req.adapter == 0` full-recompute gate is
+                    # lifted (serving/pages.py key-domain test).
                     adm = self.pages.admit(
                         sl, req.prompt,
                         len(req.prompt) + req.max_new + self._reserve + 1,
-                        share=req.adapter == 0,
+                        adapter=req.adapter_key,
                     )
                 except (PageExhaustedError, failpoints.FailpointError):
                     # Typed shed on the PR-2 overload ladder: the
@@ -3002,8 +3099,7 @@ class ContinuousBatcher:
                     continue
                 self._tables_dirty = True
                 if adm.scan_start > 0:
-                    if req.adapter == 0:
-                        self.prefix_hits += 1
+                    self.prefix_hits += 1
                     suffix = len(req.prompt) - adm.scan_start
                     if suffix <= c:
                         t_steps = 1
@@ -3013,8 +3109,7 @@ class ContinuousBatcher:
                     key = (adm.merge_start, adm.scan_start, t_steps, width)
                     paged_groups.setdefault(key, []).append((sl, req, adm))
                 else:
-                    if req.adapter == 0:
-                        self.prefix_misses += 1
+                    self.prefix_misses += 1
                     cold.append((sl, req))
                     # Eager registration (the burst shape the old pool
                     # served with _pfx_learn_from_burst): index this
@@ -3027,10 +3122,12 @@ class ContinuousBatcher:
                     # interleave-bound rows (prefilled across FUTURE
                     # ticks) must not register early, and an admission
                     # failure deregisters (free_slot discard_index).
-                    if req.adapter == 0 and not (
+                    if not (
                         ilv and len(req.prompt) > self.cfg.prefill_chunk
                     ):
-                        self.pages.register(sl, req.prompt)
+                        self.pages.register(
+                            sl, req.prompt, adapter=req.adapter_key
+                        )
             rows = cold
         for sl, req in rows:
             # The prefix pool holds BASE-model KV only: a pooled prefix

@@ -252,6 +252,12 @@ class GenerationEngine:
                 params = self._quantize_params(params)
         params = self._init_lora(params, seed)
         self.params = params
+        if self.adapter_arena is not None:
+            # Every successful arena load reinstalls the (new) factor
+            # arrays into params — the next device call serves them;
+            # shapes/shardings are load-invariant so no program ever
+            # recompiles for a new adapter.
+            self.adapter_arena.attach_commit(self._install_lora_rows)
         # Weights ride as explicit jit ARGUMENTS, never closure
         # captures: a closed-over param tree is embedded into the
         # lowered module as constants (jax warns past 2 GB — llama3-8b
@@ -290,7 +296,13 @@ class GenerationEngine:
         enabled = bool(obs.enabled) if obs is not None else True
         self.ledger = MemoryLedger(enabled=enabled)
         self.ledger.register("weights", self._ledger_weights)
-        if self.lora_enabled:
+        if self.adapter_arena is not None:
+            # Dynamic arena: the `lora` supplier reads the ARENA's
+            # arrays, not a params scan — the arena owns the rows and
+            # params holds the same objects (reconcile attributes by
+            # identity; _ledger_weights excludes the lora_ keys).
+            self.adapter_arena.register_ledger(self.ledger)
+        elif self.lora_enabled:
             self.ledger.register("lora", self._ledger_lora)
         if enabled:
             compile_watcher.watcher.install()
@@ -303,7 +315,7 @@ class GenerationEngine:
         """Target + draft model parameters (LoRA factors excluded —
         they are their own component)."""
         params = self.params
-        if self.lora_names and isinstance(params, dict):
+        if self.lora_enabled and isinstance(params, dict):
             params = {
                 **params,
                 "layers": {
@@ -317,8 +329,10 @@ class GenerationEngine:
         return out
 
     def _ledger_lora(self):
-        """The stacked per-adapter factor arrays inside params."""
-        if not self.lora_names or not isinstance(self.params, dict):
+        """The stacked per-adapter factor arrays inside params (the
+        boot-time static mode; the dynamic arena registers its own
+        supplier — AdapterArena.register_ledger)."""
+        if not self.lora_enabled or not isinstance(self.params, dict):
             return None
         return {
             k: v for k, v in self.params["layers"].items()
@@ -351,6 +365,26 @@ class GenerationEngine:
         sharded attention into replicated attention)."""
         self._note_downgrade(where, dim, entry, size, axis)
 
+    def lora_stats(self) -> dict:
+        """ServingStats lora_* scalars. Arena mode: the live registry/
+        residency/load counters; static boot-time mode: the configured
+        set is both registered and resident (loads/evictions are
+        structurally zero — that is what "frozen at boot" means); LoRA
+        off: all zeros (the proto-drift contract wants every key)."""
+        if self.adapter_arena is not None:
+            return self.adapter_arena.stats()
+        n = len(self.lora_names)
+        return {
+            "lora_adapters_registered": n,
+            "lora_adapters_resident": n,
+            "lora_rows_total": n,
+            "lora_loads": 0,
+            "lora_evictions": 0,
+            "lora_hits": 0,
+            "lora_load_ms": 0.0,
+            "lora_shed": 0,
+        }
+
     def mesh_stats(self) -> dict:
         """Mesh identity for ServingStats / the bench artifact: tensor
         chips, total devices, the human-readable shape, and how many
@@ -368,12 +402,29 @@ class GenerationEngine:
         into params["layers"] so the layer scan slices them with every
         other stacked weight. Runs AFTER quantization — adapter factors
         stay in the model dtype (they are tiny; int8 would buy nothing
-        and cost accuracy). Row 0 is the base no-op adapter."""
+        and cost accuracy). Row 0 is the base no-op adapter.
+
+        Two modes (config.LoraConfig):
+        - boot-time `adapters`: the historical static list — rows fixed
+          at init, names resolved via `resolve_adapter`.
+        - dynamic `registry` (serving/adapter_arena.py): a disk
+          registry of `.npz` factor pairs discoverable at RUNTIME, a
+          fixed-shape device arena of `arena_rows` resident rows, and
+          refcount/LRU residency managed per request — resolution goes
+          through the batcher's serialized `acquire_adapter` stream,
+          never this method."""
         self.lora_names: dict[str, int] = {}
+        self.adapter_arena = None
         adapters = list(self.serving.lora.adapters)
-        self.lora_enabled = bool(adapters)
+        registry = getattr(self.serving.lora, "registry", "")
+        self.lora_enabled = bool(adapters) or bool(registry)
         if not self.lora_enabled:
             return params
+        if adapters and registry:
+            raise ValueError(
+                "lora.registry and lora.adapters are mutually exclusive "
+                "(config.validate mirrors this)"
+            )
         if self.fam is not llama_mod:
             raise ValueError("lora serving supports dense Llama only")
         if self.pp_serving:
@@ -390,6 +441,30 @@ class GenerationEngine:
             )
         if self.serving.lora.rank < 1:
             raise ValueError("lora.rank must be >= 1")
+        if registry:
+            from ggrmcp_tpu.serving.adapter_arena import AdapterArena
+
+            self.adapter_arena = AdapterArena(
+                registry,
+                int(getattr(self.serving.lora, "arena_rows", 8)),
+                self.serving.lora.rank,
+                self.cfg,
+                mesh=self.mesh,
+            )
+            params["layers"] = {
+                **params["layers"],
+                "lora_qkv_a": self.adapter_arena.a_dev,
+                "lora_qkv_b": self.adapter_arena.b_dev,
+            }
+            logger.info(
+                "lora arena: %d device rows over registry %s (rank %d, "
+                "%d adapter(s) registered, %.1f MB resident)",
+                self.adapter_arena.rows, registry, self.serving.lora.rank,
+                len(self.adapter_arena.registered()),
+                (self.adapter_arena.a_dev.nbytes
+                 + self.adapter_arena.b_dev.nbytes) / 1e6,
+            )
+            return params
         if len(set(adapters)) != len(adapters) or "" in adapters:
             raise ValueError("lora.adapters must be unique, non-empty names")
         for name in adapters:
@@ -444,10 +519,44 @@ class GenerationEngine:
                     raise ValueError(f"lora factors {f}: {exc}") from exc
             logger.info("lora: loaded %s", f)
 
+    def _install_lora_rows(self) -> None:
+        """AdapterArena commit hook: point params["layers"] at the
+        arena's current factor arrays. Callers pass params as a jit
+        ARGUMENT, so in-flight device calls keep their old (immutable)
+        arrays and the next dispatch serves the loaded rows."""
+        arena = self.adapter_arena
+        self.params = {
+            **self.params,
+            "layers": {
+                **self.params["layers"],
+                "lora_qkv_a": arena.a_dev,
+                "lora_qkv_b": arena.b_dev,
+            },
+        }
+
+    def n_adapter_rows(self) -> int:
+        """Highest valid per-request adapter row id (0 = base). Static
+        mode: the configured adapter count; arena mode: the arena's
+        device rows (row validity, not residency — residency is the
+        arena's job)."""
+        if self.adapter_arena is not None:
+            return self.adapter_arena.rows
+        return len(self.lora_names)
+
     def resolve_adapter(self, name: str) -> int:
-        """Adapter name → served row id (0 = base; raises on unknown)."""
+        """Adapter name → served row id (0 = base; raises on unknown).
+        STATIC mode only: the dynamic arena resolves names through the
+        batcher's serialized acquire stream (a resolution there may
+        load factors H2D, which must land between ticks — use
+        ContinuousBatcher.acquire_adapter / AdapterArena.acquire)."""
         if not name:
             return 0
+        if self.adapter_arena is not None:
+            raise ValueError(
+                "dynamic adapter arena: resolve adapter names via "
+                "AdapterArena.acquire (batcher.acquire_adapter on "
+                "serving paths), not resolve_adapter"
+            )
         try:
             return self.lora_names[name]
         except KeyError:
@@ -1048,28 +1157,39 @@ class GenerationEngine:
                 f"{len(adapters)} adapters for {len(prompts)} prompts"
             )
         idx = np.zeros((tokens.shape[0],), np.int32)
-        for i, name in enumerate(adapters or []):
-            if isinstance(name, int):
-                # Range-check explicitly: jnp.take clips out-of-range
-                # gathers, which would silently serve the WRONG adapter.
-                if not 0 <= name <= len(self.lora_names):
-                    raise ValueError(
-                        f"adapter id {name} out of range "
-                        f"(0..{len(self.lora_names)})"
-                    )
-                idx[i] = name
-            else:
-                idx[i] = self.resolve_adapter(name or "")
-        with self.mesh:
-            out, out_len = self._generate_fn(
-                self.params, jnp.asarray(tokens), jnp.asarray(true_len),
-                max_new_tokens, sampling,
-                jax.random.PRNGKey(seed), jnp.int32(eos_id),
-                jnp.asarray(idx),
-            )
-        return self._decode_outputs(
-            np.asarray(out), np.asarray(out_len), eos_id
-        )
+        leases: list = []
+        try:
+            for i, name in enumerate(adapters or []):
+                if isinstance(name, int):
+                    # Range-check explicitly: jnp.take clips
+                    # out-of-range gathers, which would silently serve
+                    # the WRONG adapter.
+                    if not 0 <= name <= self.n_adapter_rows():
+                        raise ValueError(
+                            f"adapter id {name} out of range "
+                            f"(0..{self.n_adapter_rows()})"
+                        )
+                    idx[i] = name
+                elif self.adapter_arena is not None:
+                    # Pin through the call: a concurrent churn eviction
+                    # must never rewrite a row this batch is using.
+                    lease = self.adapter_arena.acquire(name or "")
+                    leases.append(lease)
+                    idx[i] = lease.row
+                else:
+                    idx[i] = self.resolve_adapter(name or "")
+            with self.mesh:
+                out, out_len = self._generate_fn(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray(true_len), max_new_tokens, sampling,
+                    jax.random.PRNGKey(seed), jnp.int32(eos_id),
+                    jnp.asarray(idx),
+                )
+            out, out_len = np.asarray(out), np.asarray(out_len)
+        finally:
+            for lease in leases:
+                self.adapter_arena.release(lease)
+        return self._decode_outputs(out, out_len, eos_id)
 
     def generate_speculative(
         self,
@@ -1143,10 +1263,14 @@ class GenerationEngine:
     ) -> Iterator[int]:
         """Single-sequence streaming: per-step jitted decode, yields
         token ids as they are sampled. `adapter`: LoRA adapter name
-        ("" = base)."""
-        lora_idx = jnp.asarray(
-            [self.resolve_adapter(adapter)], jnp.int32
-        )
+        ("" = base; arena mode pins the row for the stream's life)."""
+        lease = None
+        if self.adapter_arena is not None and adapter:
+            lease = self.adapter_arena.acquire(adapter)
+            row = lease.row
+        else:
+            row = self.resolve_adapter(adapter)
+        lora_idx = jnp.asarray([row], jnp.int32)
         prompt, max_new_tokens = fit_request(
             prompt, max_new_tokens, self.cfg.max_seq_len
         )
@@ -1157,25 +1281,29 @@ class GenerationEngine:
         max_cache = bucket_len(len(prompt) + max_new_tokens + 1,
                                maximum=self.cfg.max_seq_len)
         rng = jax.random.PRNGKey(seed)
-        with self.mesh:
-            cache = self.make_cache(1, max_cache)
-            last_logits, cache = self._prefill_fn(
-                self.params, jnp.asarray(tokens), jnp.asarray(true_len),
-                cache, lora_idx,
-            )
-            cur = sample(last_logits, jax.random.fold_in(rng, 0),
-                         sampling)
-            for i in range(max_new_tokens):
-                tok = int(cur[0])
-                if tok == eos_id:
-                    return
-                yield tok
-                if i == max_new_tokens - 1:
-                    return
-                cur, cache = self._decode_fn(
-                    self.params, cur[:, None], cache, rng, i + 1, sampling,
-                    lora_idx,
+        try:
+            with self.mesh:
+                cache = self.make_cache(1, max_cache)
+                last_logits, cache = self._prefill_fn(
+                    self.params, jnp.asarray(tokens), jnp.asarray(true_len),
+                    cache, lora_idx,
                 )
+                cur = sample(last_logits, jax.random.fold_in(rng, 0),
+                             sampling)
+                for i in range(max_new_tokens):
+                    tok = int(cur[0])
+                    if tok == eos_id:
+                        return
+                    yield tok
+                    if i == max_new_tokens - 1:
+                        return
+                    cur, cache = self._decode_fn(
+                        self.params, cur[:, None], cache, rng, i + 1,
+                        sampling, lora_idx,
+                    )
+        finally:
+            if lease is not None:
+                self.adapter_arena.release(lease)
 
     def model_info(self) -> dict:
         return _model_info(self, "moe" if self.fam is moe_mod else "llama")

@@ -62,7 +62,28 @@ import numpy as np
 
 logger = logging.getLogger("ggrmcp.serving.pages")
 
-_ROOT = 0  # chain key of the empty prefix
+_ROOT = 0  # chain key of the empty prefix (base-model domain)
+
+
+def adapter_root(adapter: str) -> int:
+    """Chain key every walk for `adapter` starts from — the key-DOMAIN
+    separation that makes cross-adapter page sharing impossible by
+    construction (ISSUE 15): an adapter'd prompt's page j is keyed by
+    hash(..., hash(adapter_root, tokens_0), ..., tokens_j), so two
+    adapters' chains can only collide as blake2b collisions (verified
+    as misses against stored tokens, like any chain collision). Keys
+    derive from the stable adapter NAME, never the arena row — rows
+    are reused after eviction; names are the tenant identity (and stay
+    stable across processes, so adapter'd pages ride the host tier's
+    file tier and TransferKV exactly like base pages)."""
+    if not adapter:
+        return _ROOT
+    h = hashlib.blake2b(digest_size=8)
+    h.update(b"lora-adapter\x00")
+    h.update(adapter.encode("utf-8", "surrogatepass"))
+    # A zero digest would alias the base domain; astronomically
+    # unlikely, and mapped off 0 so the invariant is unconditional.
+    return int.from_bytes(h.digest(), "little", signed=True) or 1
 
 
 class PageExhaustedError(RuntimeError):
@@ -243,12 +264,15 @@ class PageAllocator:
                 cow_page, cow_t = page, t
         return cow_page, cow_t
 
-    def _lookup(self, arr: np.ndarray, limit: int) -> tuple[list, int, int, int]:
+    def _lookup(
+        self, arr: np.ndarray, limit: int, root: int = _ROOT
+    ) -> tuple[list, int, int, int]:
         """Longest page-aligned indexed prefix of arr[:limit] plus the
-        best partially matching divergent page. Returns (shared pages,
-        chain key at the divergence, cow_page or -1, cow_overlap)."""
+        best partially matching divergent page, walking from `root`
+        (the adapter's key domain). Returns (shared pages, chain key at
+        the divergence, cow_page or -1, cow_overlap)."""
         p = self.page_size
-        key = _ROOT
+        key = root
         pages: list[int] = []
         for j in range(limit // p):
             toks = arr[j * p:(j + 1) * p]
@@ -355,16 +379,19 @@ class PageAllocator:
     # -- slot lifecycle ------------------------------------------------------
 
     def admit(self, slot: int, prompt: list, need_len: int,
-              share: bool = True) -> PageAdmission:
+              share: bool = True, adapter: str = "") -> PageAdmission:
         """Build slot's block table for a request that will occupy
         positions [0, need_len): reuse the longest page-aligned indexed
         prefix (refcounted), pick a CoW source for the divergent page,
         allocate fresh exclusive pages for the rest. All-or-nothing —
         PageExhaustedError leaves every resident table untouched.
-        `share=False` (LoRA-adapter rows) allocates fully exclusive and
-        consults nothing: adapter'd K/V must never alias base-model
-        pages (the same contamination rule the slot-granular pool
-        enforced)."""
+        `adapter` scopes the chain walk to that adapter's key domain
+        (adapter_root): same-adapter requests share pages and ride the
+        host tier; cross-adapter sharing is impossible by key
+        construction — the rule the old `share=False` full-recompute
+        gate enforced by never sharing at all. `share=False` still
+        allocates fully exclusive and consults nothing (transfer/test
+        paths that must bypass the index)."""
         self.free_slot(slot)  # defensive: admit implies a parked row
         p = self.page_size
         w_need = -(-need_len // p)
@@ -373,13 +400,16 @@ class PageAllocator:
                 f"request needs {w_need} pages > table width {self.width}"
             )
         arr = np.asarray(prompt, np.int32)
+        root = adapter_root(adapter)
         # At least one suffix token must run through the model to
         # produce sampling logits — cap reuse at len(prompt) - 1.
         limit = len(prompt) - 1
         if share:
-            shared, break_key, cow_page, cow_t = self._lookup(arr, limit)
+            shared, break_key, cow_page, cow_t = self._lookup(
+                arr, limit, root
+            )
         else:
-            shared, break_key, cow_page, cow_t = [], _ROOT, -1, 0
+            shared, break_key, cow_page, cow_t = [], root, -1, 0
         m = len(shared)
         # Host-tier extension (attach_host): continue the chain walk
         # past the device break — orphaned device pages re-link free,
@@ -566,7 +596,7 @@ class PageAllocator:
             ]
         return ext[:first], fresh, []
 
-    def chain_pages(self, prompt: list) -> list[int]:
+    def chain_pages(self, prompt: list, adapter: str = "") -> list[int]:
         """The indexed arena pages holding `prompt`'s full pages,
         walking the hash chain from the root — the export set a
         prefill-role replica ships over TransferKV (docs/paged_kv.md
@@ -575,10 +605,11 @@ class PageAllocator:
         a valid page-aligned prefix. Read-only: refcounts, stamps, and
         the index are untouched — handoff safety comes from the caller
         running inside the batcher's serialized executor stream, where
-        no eviction can interleave with the device gather."""
+        no eviction can interleave with the device gather. `adapter`
+        walks that adapter's key domain ("" = base)."""
         p = self.page_size
         arr = np.asarray(prompt, np.int32)
-        key = _ROOT
+        key = adapter_root(adapter)
         pages: list[int] = []
         for j in range(len(arr) // p):
             toks = arr[j * p:(j + 1) * p]
@@ -593,7 +624,8 @@ class PageAllocator:
         return pages
 
     def import_chain(
-        self, prompt: list, start_page: int, count: int
+        self, prompt: list, start_page: int, count: int,
+        adapter: str = "",
     ) -> list[tuple[int, int]]:
         """Register externally computed KV pages (a TransferKV chunk)
         for `prompt`'s full pages [start_page, start_page + count).
@@ -621,7 +653,8 @@ class PageAllocator:
                 f"outside the prompt's {full} full pages"
             )
         keys: list[int] = []
-        key = _ROOT
+        root = adapter_root(adapter)
+        key = root
         for j in range(start_page + count):
             key = self._chain(key, arr[j * p:(j + 1) * p])
             keys.append(key)
@@ -634,7 +667,7 @@ class PageAllocator:
         placed: list[tuple[int, int]] = []
         for j in todo:
             page = self._free.pop()
-            parent = keys[j - 1] if j > 0 else _ROOT
+            parent = keys[j - 1] if j > 0 else root
             self._index[keys[j]] = page
             self._key_of[page] = keys[j]
             self._tokens_of[page] = arr[j * p:(j + 1) * p].copy()
@@ -646,15 +679,17 @@ class PageAllocator:
             placed.append((j, page))
         return placed
 
-    def register(self, slot: int, prompt: list) -> None:
+    def register(self, slot: int, prompt: list, adapter: str = "") -> None:
         """Index every full page of a successfully prefilled prompt so
-        later admissions can share it. Pages already on the chain
-        (including the ones this admission itself reused) pass through;
-        a colliding-but-different index entry keeps precedence (the
-        duplicate page simply stays private to this slot)."""
+        later admissions can share it — under `adapter`'s key domain
+        ("" = base; adapter'd K/V never aliases another domain's
+        chain). Pages already on the chain (including the ones this
+        admission itself reused) pass through; a colliding-but-
+        different index entry keeps precedence (the duplicate page
+        simply stays private to this slot)."""
         p = self.page_size
         arr = np.asarray(prompt, np.int32)
-        key = _ROOT
+        key = adapter_root(adapter)
         for j in range(len(prompt) // p):
             toks = arr[j * p:(j + 1) * p]
             nxt = self._chain(key, toks)

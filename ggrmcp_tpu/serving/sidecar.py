@@ -292,13 +292,57 @@ class Sidecar:
             top_p=s.top_p if 0.0 < s.top_p < 1.0 else 1.0,
         )
 
-    async def _resolve_adapter(self, request, context) -> int:
-        """GenerateRequest.adapter name → served LoRA row id; unknown
-        names are the caller's error (INVALID_ARGUMENT), not a 500."""
+    async def _resolve_adapter(self, request, context):
+        """GenerateRequest.adapter name → (served LoRA row id, arena
+        lease or None). Static (boot-time) mode resolves against the
+        engine's fixed name table; the dynamic arena
+        (serving.lora.registry) acquires residency through the
+        batcher's serialized host-op stream — a first sighting loads
+        the factors H2D between ticks, and the returned lease pins the
+        row until the request's terminal chunk. Every failure is
+        typed: unknown names are the CALLER's error
+        (INVALID_ARGUMENT); an all-pinned arena is overload
+        (RESOURCE_EXHAUSTED, the PR-2 ladder → HTTP 429); a load
+        failure — corrupt file, injected adapter_load_fail chaos,
+        device write error — ABORTS loudly so the request sheds or
+        retries on a replica holding the adapter, never silently
+        serving base weights."""
+        from ggrmcp_tpu.serving.adapter_arena import (
+            AdapterExhaustedError,
+            AdapterLoadError,
+            UnknownAdapterError,
+        )
+
+        name = request.adapter
+        if getattr(self.generation, "adapter_arena", None) is None:
+            try:
+                return self.generation.resolve_adapter(name), None
+            except ValueError as exc:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, str(exc)
+                )
+        if not name:
+            return 0, None
         try:
-            return self.generation.resolve_adapter(request.adapter)
-        except ValueError as exc:
+            lease = await self.batcher.acquire_adapter(name)
+        except UnknownAdapterError as exc:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        except AdapterExhaustedError as exc:
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"server overloaded (adapters): {exc}",
+            )
+        except AdapterLoadError as exc:
+            await context.abort(grpc.StatusCode.ABORTED, str(exc))
+        return lease.row, lease
+
+    def _release_adapter(self, lease) -> None:
+        """Return a lease whose request never reached the batcher
+        (submit-time shed, validation abort). Idempotent host
+        bookkeeping; a submitted request's lease is released by
+        _record_terminal instead."""
+        if lease is not None:
+            self.batcher.release_adapter(lease)
 
     async def _resolve_grammar(
         self, request: serving_pb2.GenerateRequest, context
@@ -382,8 +426,11 @@ class Sidecar:
         token_ids: list[int] = []
         finish = "length"
         sampling = self._sampling(request)
-        adapter = await self._resolve_adapter(request, context)
+        # Grammar first: its aborts are lease-free; the adapter
+        # resolution may pin an arena row that must then be released
+        # on every failure path short of a successful submit.
         grammar = await self._resolve_grammar(request, context)
+        adapter, lease = await self._resolve_adapter(request, context)
         # Side micro-batcher path (the no-slot-pool fallback — absent
         # when batching.speculative=on puts the draft/verify round
         # inside the continuous batcher's tick, where top-k/top-p and
@@ -435,11 +482,14 @@ class Sidecar:
                     it = self.batcher.submit(
                         prompt, max_new, sampling, seed, unary=True,
                         adapter=adapter, trace_id=trace_id, grammar=grammar,
+                        adapter_key=request.adapter, adapter_lease=lease,
                     )
                 except OverloadedError as exc:
                     # Load shedding, not failure: RESOURCE_EXHAUSTED is
                     # the retryable-overload status (the gateway maps
-                    # it to HTTP 429 + Retry-After).
+                    # it to HTTP 429 + Retry-After). The shed request
+                    # never reached the batcher — return its arena pin.
+                    self._release_adapter(lease)
                     await context.abort(
                         grpc.StatusCode.RESOURCE_EXHAUSTED,
                         f"server overloaded ({exc.reason}): {exc}",
@@ -447,6 +497,7 @@ class Sidecar:
                 except GrammarCapacityError as exc:
                     # Too many DISTINCT schemas decoding at once —
                     # transient, retryable: same overload contract.
+                    self._release_adapter(lease)
                     await context.abort(
                         grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc)
                     )
@@ -502,8 +553,10 @@ class Sidecar:
             request.max_new_tokens or 64, self.serving.batching.max_decode_steps
         )
         seed = request.sampling.seed or 0
-        adapter = await self._resolve_adapter(request, context)
+        # Same ordering rationale as unary Generate: grammar aborts
+        # are lease-free, the adapter resolution pins a row.
         grammar = await self._resolve_grammar(request, context)
+        adapter, lease = await self._resolve_adapter(request, context)
         emitted = ""
         stops = list(request.stop)
         all_ids: list[int] = []
@@ -541,15 +594,18 @@ class Sidecar:
             it = self.batcher.submit(
                 prompt, max_new, self._sampling(request), seed,
                 adapter=adapter, trace_id=trace_id, grammar=grammar,
+                adapter_key=request.adapter, adapter_lease=lease,
             )
         except OverloadedError as exc:
             # Shed before any chunk is written — same overload contract
             # as unary Generate.
+            self._release_adapter(lease)
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 f"server overloaded ({exc.reason}): {exc}",
             )
         except GrammarCapacityError as exc:
+            self._release_adapter(lease)
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc)
             )
@@ -637,7 +693,8 @@ class Sidecar:
         )
 
     async def _ship_kv(
-        self, target: str, prompt: list[int], export: dict
+        self, target: str, prompt: list[int], export: dict,
+        adapter: str = "",
     ) -> tuple[int, int]:
         """Stream one exported prompt's pages to a peer sidecar as
         in-order TransferKV chunks. Returns (pages, wire bytes); any
@@ -672,6 +729,7 @@ class Sidecar:
                 kv_dtype=self.serving.kv_cache_dtype,
                 model_id=self.generation.cfg.name,
                 done=end == n,
+                adapter=adapter,
             )
             if quantized:
                 chunk.k_scales.CopyFrom(payload.k_scales)
@@ -715,13 +773,19 @@ class Sidecar:
                 grpc.StatusCode.ABORTED,
                 f"kv transfer failed (injected): {exc}",
             )
+        # The prefill leg runs under the request's ADAPTER (its pages
+        # are keyed in that adapter's chain domain since ISSUE 15 — a
+        # base-model prefill would compute, and ship, the wrong KV).
+        adapter, lease = await self._resolve_adapter(request, context)
         finish = "error"
         try:
             it = self.batcher.submit(
                 prompt, 1, SamplingConfig(temperature=0.0), 0,
-                unary=True, trace_id=trace_id,
+                unary=True, trace_id=trace_id, adapter=adapter,
+                adapter_key=request.adapter, adapter_lease=lease,
             )
         except OverloadedError as exc:
+            self._release_adapter(lease)
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 f"server overloaded ({exc.reason}): {exc}",
@@ -737,10 +801,12 @@ class Sidecar:
             )
         try:
             export = await self.batcher.run_host_op(
-                lambda: self.batcher.export_prompt_kv(prompt)
+                lambda: self.batcher.export_prompt_kv(
+                    prompt, adapter=request.adapter
+                )
             )
             pages, wire_bytes = await self._ship_kv(
-                target, prompt, export
+                target, prompt, export, adapter=request.adapter
             )
         except asyncio.CancelledError:
             raise  # client disconnect must cancel, not "error"
@@ -806,7 +872,8 @@ class Sidecar:
         try:
             imported, present = await batcher.run_host_op(
                 lambda: batcher.import_prompt_kv(
-                    prompt, start, k, v, k_scale, v_scale
+                    prompt, start, k, v, k_scale, v_scale,
+                    adapter=request.adapter,
                 )
             )
         except PageExhaustedError as exc:

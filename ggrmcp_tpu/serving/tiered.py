@@ -164,6 +164,8 @@ class TieredBatcher:
         adapter: int = 0,
         trace_id: str = "",
         grammar=None,
+        adapter_key: str = "",
+        adapter_lease=None,
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         last_exc: Optional[OverloadedError] = None
         probed: list[ContinuousBatcher] = []
@@ -172,6 +174,7 @@ class TieredBatcher:
                 it = tier.submit(
                     prompt, max_new, sampling, seed, unary=unary,
                     adapter=adapter, trace_id=trace_id, grammar=grammar,
+                    adapter_key=adapter_key, adapter_lease=adapter_lease,
                 )
             except OverloadedError as exc:
                 last_exc = exc
@@ -189,6 +192,17 @@ class TieredBatcher:
         for tier in probed[:-1]:
             tier.shed -= 1
         raise last_exc
+
+    async def acquire_adapter(self, name: str):
+        """Adapter-arena residency (serving/adapter_arena.py): the
+        arena is ENGINE-level — every tier resolves against the same
+        one — so the first tier's serialized host-op stream carries the
+        load (the write produces new immutable arrays; other tiers'
+        in-flight calls keep their dispatched references)."""
+        return await self.tiers[0].acquire_adapter(name)
+
+    def release_adapter(self, lease) -> None:
+        self.tiers[0].release_adapter(lease)
 
     def cache_bytes(self) -> int:
         """Total KV-cache HBM across tiers (bench/stats reporting)."""

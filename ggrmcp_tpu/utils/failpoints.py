@@ -26,6 +26,13 @@ Hook sites (the names the serving plane evaluates):
   page_exhausted same site, per paged-KV row — forces the page
                  allocator's exhaustion path (typed RESOURCE_EXHAUSTED
                  shed; batching.paged_kv=on only)
+  adapter_load_fail AdapterArena._load — before a registered LoRA
+                 adapter's factors are read + installed H2D: the load
+                 "fails" typed (AdapterLoadError → gRPC ABORTED at the
+                 sidecar), the reserved row returns to the free list,
+                 and the request sheds or retries on a replica holding
+                 the adapter — never silently serving base weights
+                 (tests/test_lora_arena.py)
   kv_transfer_fail Sidecar._prefill_and_ship — before the disaggregated
                  prefill leg exports/ships KV pages: the transfer
                  "fails" typed (gRPC ABORTED) and the gateway retries

@@ -382,6 +382,50 @@ class TestLedgerUnregistered:
         assert report.clean
         assert len(report.suppressed) == 1
 
+    # ISSUE 15 extension: the LoRA adapter arena's device factor rows
+    # (serving/adapter_arena.py — jnp.zeros working set, row-updated
+    # by dynamic loads) are exactly the persistent allocation the
+    # ledger's `lora` component must see; the real class registers
+    # through its register_ledger method (one indirection hop).
+    def test_fires_on_unregistered_adapter_arena(self, tmp_path):
+        report = lint(
+            tmp_path, "ggrmcp_tpu/serving/adapter_arena2.py", """
+            import jax.numpy as jnp
+
+            class AdapterArena:
+                def __init__(self, rows):
+                    self.a_dev = jnp.zeros((2, rows + 1, 8, 4))
+                    self.b_dev = jnp.zeros((2, rows + 1, 4, 16))
+            """,
+        )
+        assert rule_ids(report) == [
+            "ledger-unregistered", "ledger-unregistered"
+        ]
+        flagged = {f.message.split()[0] for f in report.findings}
+        assert flagged == {"self.a_dev", "self.b_dev"}
+
+    def test_adapter_arena_register_ledger_passes(self, tmp_path):
+        # The shipped AdapterArena shape: allocations in __init__, the
+        # supplier attached through a method the engine calls with its
+        # ledger — the rule's one-indirection scan covers it.
+        report = lint(
+            tmp_path, "ggrmcp_tpu/serving/adapter_arena2.py", """
+            import jax.numpy as jnp
+
+            class AdapterArena:
+                def __init__(self, rows):
+                    self.a_dev = jnp.zeros((2, rows + 1, 8, 4))
+                    self.b_dev = jnp.zeros((2, rows + 1, 4, 16))
+
+                def register_ledger(self, ledger, scope=""):
+                    ledger.register(
+                        "lora", lambda: (self.a_dev, self.b_dev),
+                        scope=scope,
+                    )
+            """,
+        )
+        assert report.clean
+
     # ISSUE 14 extension: host-pool buffers are byte-budgeted HOST
     # memory — outside jax.live_arrays(), so reconcile() can never
     # catch an unregistered pool. The rule's static complement covers

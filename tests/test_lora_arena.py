@@ -1,0 +1,824 @@
+"""Dynamic LoRA adapter arena (serving/adapter_arena.py, ISSUE 15):
+thousand-tenant serving from one continuous batch.
+
+Covers: arena residency/refcount/LRU units with typed exhaustion and
+the refcount-pin eviction regression; registry-discovered adapters
+served MID-RUN (never configured at boot) with zero recompiles
+(compile watcher asserted); mixed-adapter greedy bit-identity vs
+serial per-adapter runs on 1-chip AND the 2-device CPU mesh across
+fused/chunked/interleaved admission and paged on/off; adapter-keyed
+page-chain domain separation (same-adapter sessions share prefix
+pages, cross-adapter sharing provably impossible); adapter_load_fail
+chaos (typed — never silently serves base weights); the sidecar RPC
+surface; gateway per-tool adapter binding + x-adapter-id override
+through one sidecar; config typed validation + the env path.
+"""
+
+import asyncio
+import os
+
+import grpc
+import grpc.aio
+import numpy as np
+import pytest
+
+from ggrmcp_tpu.core.config import (
+    BatchingConfig,
+    LoraConfig,
+    MeshConfig,
+    ServingConfig,
+    apply_env,
+    default as default_config,
+)
+from ggrmcp_tpu.models import llama
+from ggrmcp_tpu.ops.sampling import SamplingConfig
+from ggrmcp_tpu.rpc.pb import serving_pb2
+from ggrmcp_tpu.serving.adapter_arena import (
+    AdapterArena,
+    AdapterExhaustedError,
+    AdapterLoadError,
+    UnknownAdapterError,
+)
+from ggrmcp_tpu.serving.batching import ContinuousBatcher
+from ggrmcp_tpu.serving.engine import GenerationEngine
+from ggrmcp_tpu.serving.pages import PageAllocator, _ROOT, adapter_root
+from ggrmcp_tpu.serving.sidecar import Sidecar
+from ggrmcp_tpu.utils import failpoints
+
+pytestmark = pytest.mark.lora_arena
+
+CFG = llama.CONFIGS["tiny-llama"]
+RANK = 4
+
+
+def factors(seed: int, scale: float = 0.25):
+    """Random pre-scaled factor pair big enough to flip greedy argmax
+    (the same calibration rationale as tests/test_lora.py)."""
+    rng = np.random.default_rng(seed)
+    out = (CFG.num_heads + 2 * CFG.num_kv_heads) * CFG.head_dim
+    a = rng.normal(0, scale, (CFG.num_layers, CFG.hidden_dim, RANK))
+    b = rng.normal(0, scale, (CFG.num_layers, RANK, out))
+    return a, b
+
+
+def save_adapter(registry: str, name: str, seed: int) -> None:
+    a, b = factors(seed)
+    np.savez(os.path.join(registry, f"{name}.npz"), a=a, b=b)
+
+
+@pytest.fixture(scope="module")
+def registry(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("lora-registry"))
+    for i, name in enumerate(("a0", "a1", "a2")):
+        save_adapter(path, name, seed=10 + i)
+    return path
+
+
+def arena_serving(registry: str, rows: int = 3, tensor: int = 2, **kw):
+    kw.setdefault("mesh", MeshConfig(tensor=tensor, data=0))
+    kw.setdefault(
+        "batching", BatchingConfig(max_batch_size=4, kv_cache_max_seq=256)
+    )
+    kw.setdefault(
+        "lora", LoraConfig(registry=registry, rank=RANK, arena_rows=rows)
+    )
+    return ServingConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def eng2(registry):
+    """2-device tensor-mesh arena engine (the TP-composition half of
+    the bit-identity acceptance)."""
+    return GenerationEngine(CFG, arena_serving(registry, rows=3, tensor=2))
+
+
+@pytest.fixture(scope="module")
+def eng1(registry):
+    """Single-device arena engine (the 1-chip half)."""
+    return GenerationEngine(
+        CFG, arena_serving(registry, rows=3, tensor=1, mesh=MeshConfig())
+    )
+
+
+async def collect(batcher, prompt, max_new, adapter=0, key="", lease=None):
+    out: list[int] = []
+    reason = None
+    async for ids, reason in batcher.submit(
+        prompt, max_new, SamplingConfig(temperature=0.0),
+        adapter=adapter, adapter_key=key, adapter_lease=lease,
+    ):
+        out.extend(ids)
+    return out, reason
+
+
+async def collect_named(batcher, prompt, max_new, name=""):
+    """Acquire-by-name through the serialized host-op stream (the
+    serving-path shape), then submit with the lease."""
+    if not name:
+        return await collect(batcher, prompt, max_new)
+    lease = await batcher.acquire_adapter(name)
+    return await collect(
+        batcher, prompt, max_new, adapter=lease.row, key=name, lease=lease
+    )
+
+
+# ---------------------------------------------------------------------------
+# Arena units: residency, LRU, refcounts, typed exhaustion, chaos
+# ---------------------------------------------------------------------------
+
+
+class TestArenaUnits:
+    def make(self, registry, rows=2):
+        return AdapterArena(registry, rows, RANK, CFG)
+
+    def test_resident_names_refcount_share_their_row(self, registry):
+        arena = self.make(registry)
+        l1 = arena.acquire("a0")
+        l2 = arena.acquire("a0")
+        assert l1.row == l2.row
+        assert arena.loads == 1 and arena.hits == 1
+        arena.release(l1)
+        arena.release(l2)
+        arena.check_invariants()
+        # refcount-0 rows stay RESIDENT as LRU cache: a re-acquire is
+        # a hit, not a reload.
+        l3 = arena.acquire("a0")
+        assert l3.row == l1.row and arena.loads == 1 and arena.hits == 2
+        arena.release(l3)
+        arena.check_invariants()
+
+    def test_lru_eviction_under_churn_and_reload(self, registry):
+        arena = self.make(registry, rows=2)
+        for name in ("a0", "a1"):
+            arena.release(arena.acquire(name))
+        # a2 needs a row: a0 is LRU → evicted; a later a0 re-acquire
+        # reloads from the registry.
+        arena.release(arena.acquire("a2"))
+        assert arena.evictions == 1
+        assert sorted(
+            n for n in ("a0", "a1", "a2") if n in arena._row_of
+        ) == ["a1", "a2"]
+        arena.check_invariants()
+        arena.release(arena.acquire("a0"))
+        assert arena.loads == 4  # a0, a1, a2, a0-again
+        arena.check_invariants()
+
+    def test_all_pinned_sheds_typed(self, registry):
+        arena = self.make(registry, rows=2)
+        pins = [arena.acquire("a0"), arena.acquire("a1")]
+        with pytest.raises(AdapterExhaustedError):
+            arena.acquire("a2")
+        assert arena.shed == 1
+        arena.check_invariants()
+        for lease in pins:
+            arena.release(lease)
+        # capacity freed → the same acquire now succeeds (eviction)
+        arena.release(arena.acquire("a2"))
+        arena.check_invariants()
+
+    def test_pinned_row_survives_churn(self, registry):
+        """The refcount-pin regression: churn through every other row
+        repeatedly — the pinned adapter's row mapping never moves and
+        its row is never rewritten."""
+        arena = self.make(registry, rows=2)
+        pin = arena.acquire("a0")
+        row = pin.row
+        for i in range(6):
+            other = ("a1", "a2")[i % 2]
+            lease = arena.acquire(other)
+            assert arena._row_of["a0"] == row
+            assert arena._name_of[row] == "a0"
+            arena.release(lease)
+            arena.check_invariants()
+        arena.release(pin)
+
+    def test_unknown_and_traversal_names_typed(self, registry):
+        arena = self.make(registry)
+        with pytest.raises(UnknownAdapterError, match="unknown adapter"):
+            arena.acquire("nope")
+        for bad in ("../x", "a/b", ".hidden"):
+            with pytest.raises(UnknownAdapterError, match="plain name"):
+                arena.acquire(bad)
+        arena.check_invariants()
+
+    def test_base_lease_is_inert(self, registry):
+        arena = self.make(registry)
+        lease = arena.acquire("")
+        assert lease.row == 0
+        arena.release(lease)
+        assert arena.resident() == 0
+        arena.check_invariants()
+
+    def test_load_failure_is_typed_and_clean(self, registry):
+        """adapter_load_fail chaos: the load fails TYPED, the reserved
+        row returns to the free list (nothing half-resident), and the
+        next un-injected acquire succeeds — degradation can never be a
+        silent base-weights serve."""
+        arena = self.make(registry)
+        failpoints.registry.arm("adapter_load_fail", every=1, times=1)
+        try:
+            with pytest.raises(AdapterLoadError, match="injected"):
+                arena.acquire("a0")
+        finally:
+            failpoints.registry.disarm()
+        assert arena.resident() == 0
+        arena.check_invariants()
+        lease = arena.acquire("a0")  # recovery: same name now loads
+        assert lease.row > 0
+        arena.release(lease)
+        arena.check_invariants()
+
+    def test_corrupt_factors_typed(self, registry, tmp_path):
+        bad = str(tmp_path)
+        np.savez(os.path.join(bad, "bad.npz"), a=np.zeros((2, 2)))
+        arena = AdapterArena(bad, 2, RANK, CFG)
+        with pytest.raises(AdapterLoadError):
+            arena.acquire("bad")
+        assert arena.resident() == 0
+        arena.check_invariants()
+
+    def test_registry_scan_is_live(self, registry, tmp_path):
+        path = str(tmp_path)
+        arena = AdapterArena(path, 2, RANK, CFG)
+        assert arena.registered() == []
+        save_adapter(path, "fresh", seed=99)
+        assert arena.registered() == ["fresh"]
+        stats = arena.stats()
+        assert stats["lora_adapters_registered"] == 1
+        assert stats["lora_rows_total"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Page-chain key domains (satellite: adapter folded into the hash chain)
+# ---------------------------------------------------------------------------
+
+
+class TestPageKeyDomains:
+    def test_roots_are_domain_separated(self):
+        assert adapter_root("") == _ROOT
+        assert adapter_root("acme") != _ROOT
+        assert adapter_root("acme") != adapter_root("beta")
+        assert adapter_root("acme") == adapter_root("acme")  # stable
+
+    def test_cross_adapter_sharing_impossible(self):
+        """The key-domain proof: the SAME prompt registered under
+        adapter A shares nothing with admissions under B or base, and
+        everything with a second A admission."""
+        alloc = PageAllocator(32, 4, slots=4, table_width=8)
+        prompt = list(range(1, 18))  # 4 full pages + tail
+        adm_a = alloc.admit(0, prompt, 24, adapter="A")
+        assert adm_a.pages_shared == 0
+        alloc.register(0, prompt, adapter="A")
+        # base and adapter-B walks see NOTHING of A's chain
+        for other in ("", "B"):
+            adm = alloc.admit(1, prompt, 24, adapter=other)
+            assert adm.pages_shared == 0 and adm.scan_start == 0
+            alloc.free_slot(1)
+        # the same-domain walk shares all four full pages
+        adm_a2 = alloc.admit(2, prompt, 24, adapter="A")
+        assert adm_a2.pages_shared == 4
+        assert adm_a2.merge_start == 16
+        # the shared pages ARE A's physical pages (stored once)
+        assert list(adm_a2.gather_row[:4]) == list(
+            alloc.chain_pages(prompt, adapter="A")
+        )
+        alloc.check_invariants()
+
+    def test_same_domain_chains_disjoint_pages(self):
+        alloc = PageAllocator(32, 4, slots=4, table_width=8)
+        prompt = list(range(1, 14))
+        alloc.admit(0, prompt, 16, adapter="A")
+        alloc.register(0, prompt, adapter="A")
+        alloc.admit(1, prompt, 16, adapter="B")
+        alloc.register(1, prompt, adapter="B")
+        pages_a = set(alloc.chain_pages(prompt, adapter="A"))
+        pages_b = set(alloc.chain_pages(prompt, adapter="B"))
+        assert pages_a and pages_b and not (pages_a & pages_b)
+        alloc.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# Mixed-adapter bit-identity: 2-device mesh, fused + chunked + mid-run
+# ---------------------------------------------------------------------------
+
+
+class TestMixedAdapterServing2Dev:
+    async def test_fused_mixed_matches_serial(self, eng2):
+        batcher = ContinuousBatcher(
+            eng2,
+            BatchingConfig(max_batch_size=4, kv_cache_max_seq=256,
+                           decode_steps_per_tick=2),
+        )
+        batcher.start()
+        try:
+            mixed = await asyncio.gather(
+                collect_named(batcher, [5, 6, 7], 6, "a0"),
+                collect_named(batcher, [5, 6, 7], 6),
+                collect_named(batcher, [5, 6, 7], 6, "a1"),
+            )
+            # serial per-adapter baselines through the SAME batcher
+            serial_a0, _ = await collect_named(batcher, [5, 6, 7], 6, "a0")
+            serial_base, _ = await collect_named(batcher, [5, 6, 7], 6)
+            serial_a1, _ = await collect_named(batcher, [5, 6, 7], 6, "a1")
+            assert mixed[0][0] == serial_a0
+            assert mixed[1][0] == serial_base
+            assert mixed[2][0] == serial_a1
+            assert serial_a0 != serial_base != serial_a1
+        finally:
+            await batcher.stop()
+        eng2.adapter_arena.check_invariants()
+
+    async def test_chunked_and_dynamic_midrun_adapter(self, eng2, registry):
+        """A > prefill_chunk prompt takes chunked admission under an
+        adapter that was NEVER configured at boot (its npz lands after
+        the engine started serving) — and the whole mix triggers zero
+        recompiles (the compile-count acceptance gate)."""
+        from ggrmcp_tpu.serving.compile_watcher import watcher
+
+        batcher = ContinuousBatcher(
+            eng2,
+            BatchingConfig(max_batch_size=2, kv_cache_max_seq=256,
+                           prefill_chunk=32),
+        )
+        await asyncio.get_running_loop().run_in_executor(
+            None, batcher.warmup
+        )
+        batcher.start()
+        try:
+            prompt = [5 + (i % 7) for i in range(48)]
+            # Absorb SHAPE-driven compiles first (the chunked grid and
+            # the short-prompt bucket both compile on first sighting,
+            # adapters or not — that is ordinary shape warmup, not what
+            # this test gates), then pin the steady state: from here
+            # the only thing that changes is the ADAPTER MIX.
+            await collect_named(batcher, prompt, 4, "a0")
+            await asyncio.gather(
+                collect_named(batcher, [5, 6, 7], 4, "a0"),
+                collect_named(batcher, [5, 6, 7], 4),
+            )
+            compiles_before = watcher.compile_count
+            # first-ever sighting of a mid-run registered adapter
+            save_adapter(registry, "midrun", seed=77)
+            chunked, reason = await collect_named(
+                batcher, prompt, 6, "midrun"
+            )
+            assert reason in ("length", "stop")
+            mixed = await asyncio.gather(
+                collect_named(batcher, [5, 6, 7], 6, "midrun"),
+                collect_named(batcher, [5, 6, 7], 6, "a1"),
+            )
+            assert watcher.compile_count == compiles_before, (
+                "changing the adapter mix (incl. a first-ever dynamic "
+                "adapter) must not recompile anything"
+            )
+            solo_long, _ = eng2.generate(
+                [prompt], max_new_tokens=6, adapters=["midrun"]
+            )
+            solo_short, _ = eng2.generate(
+                [[5, 6, 7]], max_new_tokens=6, adapters=["midrun"]
+            )
+            solo_a1, _ = eng2.generate(
+                [[5, 6, 7]], max_new_tokens=6, adapters=["a1"]
+            )
+            assert chunked == solo_long[0]
+            assert mixed[0][0] == solo_short[0]
+            assert mixed[1][0] == solo_a1[0]
+        finally:
+            await batcher.stop()
+
+    async def test_interleaved_admission_carries_adapter(self, eng2):
+        """prefill_interleave=on: a long adapter'd prompt arriving
+        mid-decode rides tick-fused chunk admission; output stays
+        bit-identical to the solo run either way the scheduler lands."""
+        batcher = ContinuousBatcher(
+            eng2,
+            BatchingConfig(
+                max_batch_size=4, kv_cache_max_seq=256, prefill_chunk=32,
+                prefill_interleave="on", prefill_interleave_rows=2,
+            ),
+        )
+        batcher.start()
+        try:
+            long_p = [3 + (i % 11) for i in range(80)]
+            base_task = asyncio.ensure_future(
+                collect_named(batcher, [9, 8, 7], 24)
+            )
+            await asyncio.sleep(0.05)  # let decode ticks start
+            adapterd, reason = await collect_named(batcher, long_p, 6, "a2")
+            await base_task
+            assert reason in ("length", "stop")
+            solo, _ = eng2.generate(
+                [long_p], max_new_tokens=6, adapters=["a2"]
+            )
+            assert adapterd == solo[0]
+        finally:
+            await batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# 1-chip parity + paged sharing
+# ---------------------------------------------------------------------------
+
+
+class TestOneChipAndPaged:
+    async def test_paged_on_off_bit_identity_1chip(self, eng1):
+        outs = {}
+        for paged in ("off", "on"):
+            batcher = ContinuousBatcher(
+                eng1,
+                BatchingConfig(
+                    max_batch_size=4, kv_cache_max_seq=256,
+                    paged_kv=paged, paged_kv_page_size=16,
+                ),
+            )
+            batcher.start()
+            try:
+                got = await asyncio.gather(
+                    collect_named(batcher, [5, 6, 7], 6, "a0"),
+                    collect_named(batcher, [5, 6, 7], 6),
+                    collect_named(batcher, [5, 6, 7], 6, "a1"),
+                )
+                outs[paged] = [tokens for tokens, _ in got]
+            finally:
+                await batcher.stop()
+        assert outs["on"] == outs["off"]
+        solo_a0, _ = eng1.generate(
+            [[5, 6, 7]], max_new_tokens=6, adapters=["a0"]
+        )
+        assert outs["off"][0] == solo_a0[0]
+
+    async def test_same_adapter_sessions_share_prefix_pages(self, eng1):
+        """The lifted storability gate: two same-adapter sessions with
+        a shared page-aligned preamble — the second admission reuses
+        the first's pages (today-before-this-PR it was a full
+        recompute), and the tokens still match the solo run."""
+        batcher = ContinuousBatcher(
+            eng1,
+            BatchingConfig(
+                max_batch_size=4, kv_cache_max_seq=256,
+                paged_kv="on", paged_kv_page_size=16,
+            ),
+        )
+        batcher.start()
+        preamble = [7, 3, 9, 1] * 10  # 40 tokens → 2 full pages
+        try:
+            first, _ = await collect_named(
+                batcher, preamble + [5], 6, "a0"
+            )
+            reused_before = batcher.pages.pages_reused
+            second, _ = await collect_named(
+                batcher, preamble + [5], 6, "a0"
+            )
+            assert second == first
+            assert batcher.pages.pages_reused > reused_before, (
+                "same-adapter sessions must share prefix pages"
+            )
+            # cross-adapter: the SAME preamble under another adapter
+            # shares nothing (key domains)
+            reused_before = batcher.pages.pages_reused
+            other, _ = await collect_named(
+                batcher, preamble + [5], 6, "a1"
+            )
+            assert batcher.pages.pages_reused == reused_before
+            solo_a1, _ = eng1.generate(
+                [preamble + [5]], max_new_tokens=6, adapters=["a1"]
+            )
+            assert other == solo_a1[0]
+        finally:
+            await batcher.stop()
+
+    async def test_adapterd_kv_export_import_round_trip(self, eng1):
+        """The lifted disagg gate end-to-end at the batcher layer: an
+        adapter'd prompt's pages export under the adapter's key domain
+        and import into a second arena, whose SAME-adapter admission
+        then shares them (prefill skipped) with bit-identical output —
+        while a base-domain admission of the same prompt shares
+        nothing."""
+        cfg = BatchingConfig(
+            max_batch_size=2, kv_cache_max_seq=256,
+            paged_kv="on", paged_kv_page_size=16,
+        )
+        prompt = [7, 3, 9, 1] * 10 + [5]  # 2 full pages + tail
+        src = ContinuousBatcher(eng1, cfg)
+        src.start()
+        try:
+            expect, _ = await collect_named(src, prompt, 6, "a0")
+            export = await src.run_host_op(
+                lambda: src.export_prompt_kv(prompt, adapter="a0")
+            )
+            assert export["pages"] == 2
+        finally:
+            await src.stop()
+        dst = ContinuousBatcher(eng1, cfg)
+        dst.start()
+        try:
+            imported, present = await dst.run_host_op(
+                lambda: dst.import_prompt_kv(
+                    prompt, 0, export["k"], export["v"], adapter="a0"
+                )
+            )
+            assert (imported, present) == (2, 0)
+            # base-domain walk of the same tokens sees nothing
+            assert dst.pages.chain_pages(prompt) == []
+            got, _ = await collect_named(dst, prompt, 6, "a0")
+            assert got == expect
+            assert dst.pages.pages_reused >= 2  # prefill skipped
+        finally:
+            await dst.stop()
+
+    async def test_tick_failure_replay_keeps_adapter(self, eng1):
+        """Chaos: a failed tick replays the adapter'd victim with its
+        emitted prefix — the lease stays pinned through the replay and
+        greedy output is bit-identical to the fault-free run."""
+        solo, _ = eng1.generate(
+            [[5, 6, 7]], max_new_tokens=8, adapters=["a0"]
+        )
+        batcher = ContinuousBatcher(
+            eng1,
+            BatchingConfig(max_batch_size=2, kv_cache_max_seq=256,
+                           tick_retry_limit=2),
+        )
+        batcher.start()
+        failpoints.registry.arm("tick_fail", every=3, times=1)
+        try:
+            tokens, reason = await collect_named(
+                batcher, [5, 6, 7], 8, "a0"
+            )
+            assert reason in ("length", "stop")
+            assert tokens == solo[0]
+            assert batcher.replayed >= 1
+        finally:
+            failpoints.registry.disarm()
+            await batcher.stop()
+        eng1.adapter_arena.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# Sidecar RPC surface
+# ---------------------------------------------------------------------------
+
+
+class TestSidecarArena:
+    async def test_typed_resolution_and_stats(self, registry):
+        side = Sidecar(arena_serving(registry, rows=2))
+        port = await side.start(0)
+        channel = grpc.aio.insecure_channel(f"localhost:{port}")
+        gen = channel.unary_unary(
+            "/ggrmcp.tpu.GenerateService/Generate",
+            request_serializer=serving_pb2.GenerateRequest.SerializeToString,
+            response_deserializer=serving_pb2.GenerateResponse.FromString,
+        )
+        stats_call = channel.unary_unary(
+            "/ggrmcp.tpu.ModelInfoService/GetServingStats",
+            request_serializer=(
+                serving_pb2.ServingStatsRequest.SerializeToString
+            ),
+            response_deserializer=(
+                serving_pb2.ServingStatsResponse.FromString
+            ),
+        )
+        try:
+            base = await gen(serving_pb2.GenerateRequest(
+                prompt="hello", max_new_tokens=4
+            ))
+            via = await gen(serving_pb2.GenerateRequest(
+                prompt="hello", max_new_tokens=4, adapter="a0"
+            ))
+            assert via.text != base.text  # loaded factors take effect
+
+            with pytest.raises(grpc.aio.AioRpcError) as exc:
+                await gen(serving_pb2.GenerateRequest(
+                    prompt="hello", max_new_tokens=4, adapter="nope"
+                ))
+            assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+            # injected load failure: ABORTED, never a silent base serve
+            failpoints.registry.arm("adapter_load_fail", every=1, times=1)
+            try:
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await gen(serving_pb2.GenerateRequest(
+                        prompt="hello", max_new_tokens=4, adapter="a1"
+                    ))
+                assert exc.value.code() == grpc.StatusCode.ABORTED
+                assert "load failed" in exc.value.details()
+            finally:
+                failpoints.registry.disarm()
+            # recovery: the same adapter serves after the fault clears
+            ok = await gen(serving_pb2.GenerateRequest(
+                prompt="hello", max_new_tokens=4, adapter="a1"
+            ))
+            assert ok.finish_reason in ("length", "stop")
+
+            # all rows pinned → typed overload (RESOURCE_EXHAUSTED)
+            arena = side.generation.adapter_arena
+            pins = [arena.acquire("a0"), arena.acquire("a1")]
+            try:
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await gen(serving_pb2.GenerateRequest(
+                        prompt="hello", max_new_tokens=4, adapter="a2"
+                    ))
+                assert exc.value.code() == (
+                    grpc.StatusCode.RESOURCE_EXHAUSTED
+                )
+            finally:
+                for lease in pins:
+                    arena.release(lease)
+
+            stats = await stats_call(serving_pb2.ServingStatsRequest())
+            assert stats.lora_adapters_registered >= 3
+            assert stats.lora_rows_total == 2
+            assert stats.lora_loads >= 2
+            assert stats.lora_shed >= 1
+            arena.check_invariants()
+        finally:
+            await channel.close()
+            await side.stop()
+
+
+# ---------------------------------------------------------------------------
+# Gateway: two tools bound to two adapters through one sidecar
+# ---------------------------------------------------------------------------
+
+
+class TestGatewayAdapterBinding:
+    GEN = "ggrmcp_tpu_generateservice_generate"
+    STREAM = "ggrmcp_tpu_generateservice_generatestream"
+
+    async def test_binding_and_override_e2e(self, registry):
+        import aiohttp
+
+        from ggrmcp_tpu.gateway.app import Gateway
+
+        cfg = default_config()
+        cfg.server.host = "127.0.0.1"
+        # two tools, two adapters, ONE sidecar — one pod, many tenants
+        cfg.gateway.tools = {
+            self.GEN: {"adapter": "a0"},
+            self.STREAM: {"adapter": "a1"},
+        }
+        cfg.validate()  # the binding config is valid BEFORE test-only
+        cfg.server.port = 0  # ...overrides (0 = ephemeral, test-only)
+        cfg.grpc.reconnect.enabled = False
+        side = Sidecar(arena_serving(registry, rows=3))
+        port = await side.start(0)
+        gw = Gateway(cfg, targets=[f"localhost:{port}"])
+        await gw.start()
+
+        async def call(client, tool, args, headers=None):
+            resp = await client.post("/", json={
+                "jsonrpc": "2.0", "method": "tools/call", "id": 1,
+                "params": {"name": tool, "arguments": args},
+            }, headers=headers or {})
+            data = await resp.json()
+            assert "error" not in data, data
+            import json as _json
+
+            # one content entry per chunk (streaming tools aggregate)
+            return [
+                _json.loads(c["text"])
+                for c in data["result"]["content"]
+            ]
+
+        try:
+            async with aiohttp.ClientSession(
+                base_url=f"http://127.0.0.1:{gw.port}"
+            ) as client:
+                args = {"prompt": "hi", "maxNewTokens": 4}
+                bound = (await call(client, self.GEN, args))[0]
+                explicit_a0 = (await call(
+                    client, self.GEN, {**args, "adapter": "a0"}
+                ))[0]
+                explicit_a2 = (await call(
+                    client, self.GEN, {**args, "adapter": "a2"}
+                ))[0]
+                # the binding serves a0; an explicit argument wins
+                assert bound["text"] == explicit_a0["text"]
+                assert explicit_a2["text"] != explicit_a0["text"]
+
+                # per-session override: x-adapter-id beats the binding
+                # (fresh session so the header snapshot carries it)
+                overridden = (await call(
+                    client, self.GEN, args,
+                    headers={"x-adapter-id": "a2"},
+                ))[0]
+                assert overridden["text"] == explicit_a2["text"]
+
+                # the second tool is bound to the second adapter —
+                # aggregated streaming call through the same sidecar
+                streamed = await call(client, self.STREAM, args)
+                explicit_a1 = (await call(
+                    client, self.GEN, {**args, "adapter": "a1"}
+                ))[0]
+                text = "".join(
+                    c.get("textDelta", "") for c in streamed
+                )
+                assert text == explicit_a1["text"]
+
+                # lora gauges export on /metrics
+                metrics = await (await client.get("/metrics")).text()
+                assert "gateway_backend_lora_adapters_registered" in metrics
+                assert "gateway_backend_lora_loads" in metrics
+        finally:
+            await gw.stop()
+            await side.stop()
+
+
+# ---------------------------------------------------------------------------
+# Config surface
+# ---------------------------------------------------------------------------
+
+
+class TestConfigValidation:
+    def test_registry_and_adapters_exclusive(self):
+        cfg = default_config()
+        cfg.serving.lora.registry = "/tmp/x"
+        cfg.serving.lora.adapters = ["a"]
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            cfg.validate()
+
+    def test_arena_rows_positive(self):
+        cfg = default_config()
+        cfg.serving.lora.arena_rows = 0
+        with pytest.raises(ValueError, match="arena_rows"):
+            cfg.validate()
+
+    def test_gateway_tools_typed_validation(self):
+        cfg = default_config()
+        cfg.gateway.tools = {"t": {"adapter": ""}}
+        with pytest.raises(ValueError, match="non-empty adapter name"):
+            cfg.validate()
+        cfg.gateway.tools = {"t": {"unknown_key": "x"}}
+        with pytest.raises(ValueError, match="unknown keys"):
+            cfg.validate()
+        cfg.gateway.tools = {"t": "a0"}
+        with pytest.raises(ValueError, match="settings dicts"):
+            cfg.validate()
+        cfg.gateway.tools = {"t": {"adapter": "a0"}}
+        cfg.validate()
+
+    def test_env_path_reaches_registry(self):
+        cfg = default_config()
+        apply_env(cfg, {
+            "GGRMCP_SERVING_LORA_REGISTRY": "/srv/adapters",
+            "GGRMCP_SERVING_LORA_ARENA_ROWS": "16",
+        })
+        assert cfg.serving.lora.registry == "/srv/adapters"
+        assert cfg.serving.lora.arena_rows == 16
+
+    def test_x_adapter_id_forwarded_by_default(self):
+        cfg = default_config()
+        assert "x-adapter-id" in cfg.grpc.header_forwarding.allowed_headers
+
+    def test_engine_rejects_registry_plus_static(self, registry):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            GenerationEngine(CFG, arena_serving(
+                registry,
+                lora=LoraConfig(
+                    registry=registry, adapters=["a0"], rank=RANK
+                ),
+            ))
+
+
+# ---------------------------------------------------------------------------
+# Router: adapter affinity
+# ---------------------------------------------------------------------------
+
+
+class TestAdapterAffinity:
+    def test_adapter_key_precedence(self):
+        from ggrmcp_tpu.rpc.router import derive_affinity_key
+
+        key = derive_affinity_key(
+            "tool", {"prompt": "x", "adapter": "acme"},
+            [("x-session-id", "s1")], 64,
+        )
+        assert key == b"a:acme"
+        key = derive_affinity_key(
+            "tool", {"prompt": "x"},
+            [("x-adapter-id", "beta"), ("x-session-id", "s1")], 64,
+        )
+        assert key == b"a:beta"
+        key = derive_affinity_key(
+            "tool", {"prompt": "x"}, [("x-session-id", "s1")], 64
+        )
+        assert key == b"s:s1"
+
+    def test_same_adapter_lands_one_replica(self):
+        from ggrmcp_tpu.core.config import RoutingConfig
+        from ggrmcp_tpu.rpc.router import ReplicaRouter
+
+        class B:
+            def __init__(self, target):
+                self.target = target
+
+        router = ReplicaRouter(
+            RoutingConfig(policy="affinity", spill_threshold=0)
+        )
+        replicas = [B("r1:1"), B("r2:1"), B("r3:1")]
+        homes = {
+            router.pick(
+                "tool", replicas, affinity_key=b"a:acme"
+            ).target
+            for _ in range(8)
+        }
+        assert len(homes) == 1  # one adapter → one home replica
