@@ -6,7 +6,8 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: proto proto-check descriptors test test-all test-fast test-chaos \
-  test-obs test-grammar test-spec-batch test-paged test-tp test-analysis \
+  test-obs test-grammar test-grammar-jump test-spec-batch test-paged \
+  test-tp test-analysis \
   test-disagg test-fleet test-mem test-kvtier test-lora-arena bench-cpu \
   smoke e2e lint graftlint ci-local preflight clean
 
@@ -63,6 +64,12 @@ test-obs:
 # too; this target is the fast inner loop for ggrmcp_tpu/grammar work.
 test-grammar:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m grammar
+
+# Jump-ahead constrained decoding alone (CPU mesh): forced-run table
+# units, greedy bit-identity jump-on vs jump-off across every admission
+# path, compile-count stability, and the grammar_jump_fail degrade.
+test-grammar-jump:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m grammar_jump
 
 # Speculative continuous batching alone (CPU mesh): greedy bitwise
 # identity spec-on vs spec-off across every admission path, filtered

@@ -78,6 +78,17 @@ Environment knobs:
                          preamble agentic workload and exports tokens/s,
                          prefix hit rates, and KV HBM in use for both
                          modes (paged_* extras; docs/paged_kv.md).
+  GGRMCP_BENCH_JUMP      jump-ahead constrained decoding A/B phase
+                         ("on" by default off-TPU, "off" skips): runs
+                         grammar.jump_max on (default window) vs 0 on
+                         the same engine over an enum/const-rich
+                         JSON-schema constrained greedy workload and
+                         exports tokens/s, per-call latency, the
+                         forced-token fraction (jump tokens over all
+                         constrained tokens), and the jump-run length
+                         histogram (jump_* extras; full phase result in
+                         bench_artifacts/grammar_jump.json;
+                         docs/structured_output.md "Jump-ahead").
   GGRMCP_BENCH_KVTIER    host-tier KV page pool A/B phase ("on" by
                          default off-TPU, "off" skips): two PAGED
                          batchers — paged_kv_host_bytes 0 vs set —
@@ -1363,6 +1374,22 @@ async def _run_bench() -> dict:
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: specbatch phase failed: {exc!r}", file=sys.stderr)
 
+    # Jump-ahead constrained decoding A/B (GGRMCP_BENCH_JUMP,
+    # docs/structured_output.md "Jump-ahead"): same isolation rationale
+    # as the specbatch phase — runs after the serving stack is down, on
+    # its own batchers.
+    jump = {}
+    want_jump = os.environ.get("GGRMCP_BENCH_JUMP")
+    if want_jump == "on" or (
+        want_jump is None and not headline_only and not on_tpu
+    ):
+        try:
+            jump = await _jump_bench(
+                model, max_new, tick_steps, quantize, kv_dtype, synth,
+            )
+        except Exception as exc:  # secondary phase must not sink the run
+            print(f"bench: jump phase failed: {exc!r}", file=sys.stderr)
+
     # Paged KV A/B (GGRMCP_BENCH_PAGED, docs/paged_kv.md): same
     # isolation rationale as the specbatch phase — runs after the
     # serving stack is down, on its own batchers.
@@ -1432,7 +1459,8 @@ async def _run_bench() -> dict:
             print(f"bench: proxy phase failed: {exc!r}", file=sys.stderr)
     return {
         **headline, **hbm, **obs_export, **prefix, **longp, **mixed,
-        **grammar, **ticktime, **specbatch, **paged, **kvtier, **lora,
+        **grammar, **ticktime, **specbatch, **jump, **paged, **kvtier,
+        **lora,
         **tp, **proxy,
     }
 
@@ -2110,6 +2138,180 @@ async def _specbatch_bench(
             on["tick_ms"] - off["tick_ms"], 2
         ),
     }
+
+
+async def _jump_bench(
+    model: str, max_new: int, tick_steps, quantize: str, kv_dtype: str,
+    synth: bool,
+) -> dict:
+    """Jump-ahead constrained decoding A/B (docs/structured_output.md
+    "Jump-ahead"): ONE engine, two batchers — grammar.jump_max 0 then
+    the config default — driven by the same enum/const-rich JSON-schema
+    constrained greedy workload (the forced-run-heavy shape the jump
+    tick exists for). Exports tokens/s, per-call latency, the
+    forced-token fraction (jump tokens / all constrained tokens), and
+    the jump-run length histogram; the full phase result also lands in
+    bench_artifacts/grammar_jump.json. Greedy on vs off is
+    bit-identical by construction, so the uplift is pure wall-clock.
+    The caller gates on GGRMCP_BENCH_JUMP."""
+    import asyncio as _asyncio
+    import dataclasses as _dc
+
+    from ggrmcp_tpu.core.config import (
+        BatchingConfig, GrammarConfig, MeshConfig, ObservabilityConfig,
+        ServingConfig,
+    )
+    from ggrmcp_tpu.grammar import compile_schema
+    from ggrmcp_tpu.models import get_model
+    from ggrmcp_tpu.ops.sampling import SamplingConfig
+    from ggrmcp_tpu.serving.batching import ContinuousBatcher
+    from ggrmcp_tpu.serving.engine import GenerationEngine
+
+    _, mcfg = get_model(model)
+    engine = GenerationEngine(mcfg, ServingConfig(
+        model=model,
+        quantize=quantize,
+        kv_cache_dtype=kv_dtype,
+        synthetic_weights=synth,
+        mesh=MeshConfig(tensor=0),
+        observability=ObservabilityConfig(enabled=False),
+    ))
+    # Enum/const-rich schema: long literal spans (keys, const values,
+    # enum arms sharing prefixes only at the quote) force multi-token
+    # runs — the structured-output shape of MCP tool results.
+    schema = {
+        "type": "object",
+        "properties": {
+            "verdict": {"enum": ["approved", "rejected"]},
+            "category": {"const": "structured-output"},
+            "confidence": {"type": "number"},
+            "flags": {
+                "type": "array",
+                "items": {"enum": ["checked", "partial"]},
+                "maxItems": 2,
+            },
+        },
+        "required": ["verdict", "category", "confidence", "flags"],
+    }
+    grammar = compile_schema(
+        schema, vocab_size=mcfg.vocab_size,
+        max_states=engine.serving.grammar.max_states,
+    )
+    slots = int(os.environ.get("GGRMCP_BENCH_JUMP_SLOTS", "8"))
+    calls = 3 * slots
+    budget = max(128, max_new)
+    greedy = SamplingConfig(temperature=0.0)
+    jump_window = engine.serving.grammar.jump_max
+    base_grammar = engine.serving.grammar
+    loop = _asyncio.get_running_loop()
+    runs: dict[str, dict] = {}
+    outputs: dict[str, list] = {}
+    for mode, jmax in (("off", 0), ("on", jump_window)):
+        # The batcher reads serving.grammar.jump_max at construction;
+        # swap a copied GrammarConfig in for the construction window.
+        engine.serving.grammar = _dc.replace(base_grammar, jump_max=jmax)
+        try:
+            batcher = ContinuousBatcher(engine, BatchingConfig(
+                max_batch_size=slots,
+                kv_cache_max_seq=512,
+                decode_steps_per_tick=tick_steps,
+            ))
+        finally:
+            engine.serving.grammar = base_grammar
+        await loop.run_in_executor(None, batcher.warmup)
+        batcher.start()
+        try:
+            async def call(i: int, b=batcher):
+                out = []
+                t0 = time.perf_counter()
+                async for ids, _reason in b.submit(
+                    [3 + (i * 13) % 200, 7, (i * 29) % 200 + 3],
+                    budget, greedy, seed=i, grammar=grammar,
+                ):
+                    out.extend(ids)
+                return time.perf_counter() - t0, out
+
+            # Warm wave off the clock (programs compiled in warmup;
+            # this settles the arena upload + caches).
+            await _asyncio.gather(*(call(1000 + i) for i in range(slots)))
+            t0 = time.perf_counter()
+            results = await _asyncio.gather(
+                *(call(i) for i in range(calls))
+            )
+            elapsed = time.perf_counter() - t0
+        finally:
+            await batcher.stop()
+        stats = batcher.stats()
+        latencies = sorted(dt for dt, _out in results)
+        tokens = sum(len(out) for _dt, out in results)
+        outputs[mode] = [out for _dt, out in results]
+        runs[mode] = {
+            "tokens_per_sec": tokens / elapsed,
+            "call_ms_p50": latencies[len(latencies) // 2] * 1e3,
+            "call_ms_max": latencies[-1] * 1e3,
+            "masked": stats.get("grammar_masked_tokens", 0),
+            "jump_tokens": stats.get("grammar_jump_tokens", 0),
+            "jump_runs": stats.get("grammar_jump_runs", 0),
+            "fallbacks": stats.get("grammar_jump_fallbacks", 0),
+        }
+    # Greedy bit-identity on vs off is the tentpole's correctness
+    # contract — a bench that measured divergent outputs would be
+    # comparing two different workloads.
+    assert outputs["on"] == outputs["off"], "jump on/off outputs diverge"
+    # Run-length histogram from the host arena mirror: replay each
+    # emitted sequence through the compiled DFA, taking the same
+    # window-capped forced run the device took (greedy → identical).
+    hist: dict[int, int] = {}
+    for out in outputs["on"]:
+        s, i = grammar.start, 0
+        while i < len(out):
+            length = min(len(grammar.forced_run(s)), jump_window)
+            if length:
+                hist[length] = hist.get(length, 0) + 1
+            step = min(length + 1, len(out) - i)
+            for tok in out[i:i + step]:
+                s = grammar.step(s, tok)
+            i += step
+    off, on = runs["off"], runs["on"]
+    result = {
+        "jump_model": model,
+        "jump_window": jump_window,
+        "jump_calls": calls,
+        "jump_max_new": budget,
+        "jump_off_tokens_per_sec": round(off["tokens_per_sec"], 1),
+        "jump_on_tokens_per_sec": round(on["tokens_per_sec"], 1),
+        "jump_uplift_pct": round(
+            (on["tokens_per_sec"] / off["tokens_per_sec"] - 1.0) * 100.0, 1
+        ) if off["tokens_per_sec"] > 0 else 0.0,
+        "jump_off_call_ms_p50": round(off["call_ms_p50"], 1),
+        "jump_on_call_ms_p50": round(on["call_ms_p50"], 1),
+        "jump_off_call_ms_max": round(off["call_ms_max"], 1),
+        "jump_on_call_ms_max": round(on["call_ms_max"], 1),
+        # Forced-token fraction: jump-emitted tokens over ALL tokens
+        # decoded under the grammar mask in the on run — the share of
+        # the constrained stream that skipped its forward pass.
+        "jump_forced_fraction": round(
+            on["jump_tokens"] / on["masked"], 4
+        ) if on["masked"] else 0.0,
+        "jump_runs_total": on["jump_runs"],
+        "jump_fallbacks": on["fallbacks"],
+        "jump_run_length_hist": {
+            str(k): v for k, v in sorted(hist.items())
+        },
+    }
+    try:
+        art_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_artifacts"
+        )
+        os.makedirs(art_dir, exist_ok=True)
+        with open(
+            os.path.join(art_dir, "grammar_jump.json"), "w",
+            encoding="utf-8",
+        ) as fh:
+            json.dump(result, fh, indent=1, sort_keys=True)
+    except OSError as exc:  # artifact write must not sink the phase
+        print(f"bench: jump artifact write failed: {exc}", file=sys.stderr)
+    return result
 
 
 def _kill_proxy_group() -> None:

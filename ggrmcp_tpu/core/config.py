@@ -529,6 +529,18 @@ class GrammarConfig:
     arena_states: int = 4096
     # Sidecar-side LRU of compiled DFAs, keyed by canonical schema hash.
     cache_entries: int = 32
+    # Jump-ahead constrained decoding (SGLang compressed-FSM
+    # jump-forward / XGrammar forced runs; docs/structured_output.md
+    # "Jump-ahead"): when a slot's DFA state admits exactly one token
+    # (or a chain of such states), the jitted tick emits up to jump_max
+    # forced tokens in ONE multi-position forward instead of one
+    # forward per token. 0 disables (plain one-token constrained
+    # decoding); the window is static — shape-invariant across schema
+    # mixes, so nothing recompiles — and bounded by the compiler's
+    # per-state precompute cap (compiler.JUMP_CAP = 16). Greedy output
+    # is bit-identical on vs off (forced tokens are what masked
+    # sampling would emit anyway), so the default is on.
+    jump_max: int = 8
 
 
 # Replica-routing policies (gateway.routing.policy) — the single source
@@ -1009,6 +1021,14 @@ class Config:
             )
         if grammar.cache_entries < 1:
             raise ValueError("grammar.cache_entries must be >= 1")
+        if not 0 <= grammar.jump_max <= 16:
+            # Upper bound = compiler.JUMP_CAP: runs are precomputed to
+            # 16 tokens per state; a wider serving window would jump
+            # shorter than configured, silently.
+            raise ValueError(
+                "grammar.jump_max must be in [0, 16] (0 disables "
+                "jump-ahead; 16 is the compiler's forced-run cap)"
+            )
         so = self.gateway.structured_output
         if not isinstance(so, dict) or not all(
             isinstance(k, str) and isinstance(v, str)

@@ -98,6 +98,12 @@ _SERVING_HELP = {
         "tokens emitted under an active grammar mask",
     "grammar_states_in_use":
         "DFA states resident in the grammar table arena",
+    "grammar_jump_tokens":
+        "forced tokens emitted by jump-ahead runs (no forward pass)",
+    "grammar_jump_runs": "forced multi-token jump-ahead runs collapsed",
+    "grammar_jump_fallbacks":
+        "jump runs refused by validation (slot degraded to one-token "
+        "constrained decoding)",
     "kv_pages_total": "paged KV arena size in pages",
     "kv_pages_in_use":
         "paged KV pages resident (live + reuse-cached)",
@@ -329,6 +335,9 @@ _TICK_HELP = {
     "phase_wait_ms":
         "device wait + transfer (incl. pipelined in-flight lag)",
     "phase_host_ms": "emission, finish handling, allocator bookkeeping",
+    "jump_tokens":
+        "forced tokens emitted by jump-ahead runs on this tick",
+    "jump_runs": "jump-ahead forced runs collapsed on this tick",
 }
 
 

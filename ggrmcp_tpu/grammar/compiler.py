@@ -355,13 +355,28 @@ class CompiledGrammar:
 
     States are LOCAL (0 = start); the batcher's GrammarArena relocates
     them to a global base when the grammar becomes live (trans + base
-    works because disallowed/self transitions are self-loops).
+    works because disallowed/self transitions are self-loops; jump_states
+    entries are always valid local ids for the same reason).
+
+    Forced-run tables (SGLang compressed-FSM jump-forward / XGrammar
+    forced-token compilation): a state is FORCED when exactly one token
+    is admissible and it is not EOS (accepting states always admit EOS,
+    so they are never forced). jump_len[s] is the length of the maximal
+    forced chain from s (0 at branching/accepting states), capped at
+    the compile-time jump_cap; jump_tokens[s, :L] are the chain's token
+    ids and jump_states[s, k] is the state after consuming
+    jump_tokens[s, :k+1] — the landing state of an L-token jump is
+    jump_states[s, L-1]. Padding entries keep token 0 / the landing
+    state so every jump_states cell relocates in-range.
     """
 
     allow: np.ndarray      # [n_states, vocab] bool — sampleable tokens
     trans: np.ndarray      # [n_states, vocab] int32 — next LOCAL state
     accept: np.ndarray     # [n_states] bool — EOS is legal here
     sink: np.ndarray       # [n_states] bool — accepting, no way forward
+    jump_len: np.ndarray     # [n_states] int32 — forced-run length
+    jump_tokens: np.ndarray  # [n_states, jump_cap] int32 — run token ids
+    jump_states: np.ndarray  # [n_states, jump_cap] int32 — run states
     n_states: int
     schema_hash: str
     vocab_size: int
@@ -392,6 +407,12 @@ class CompiledGrammar:
             s = int(self.trans[s, token])
         return bool(self.accept[s])
 
+    def forced_run(self, state: int) -> list:
+        """The forced token run from `state` (host-side mirror of the
+        device jump: empty at branching/accepting states)."""
+        length = int(self.jump_len[state])
+        return [int(t) for t in self.jump_tokens[state, :length]]
+
 
 def schema_fingerprint(schema: "str | dict") -> str:
     """Canonical hash for compile caching: whitespace/key-order
@@ -407,12 +428,67 @@ def schema_fingerprint(schema: "str | dict") -> str:
     return hashlib.sha256(canon.encode("utf-8")).hexdigest()
 
 
+# Compile-time forced-run bound: runs are precomputed up to this many
+# tokens per state; the arena truncates further to the serving-time
+# window (serving.grammar.jump_max), so compiling wider than any
+# reasonable serving window costs only host memory at compile time.
+JUMP_CAP = 16
+
+
+def compute_jump_tables(
+    allow: np.ndarray, trans: np.ndarray, eos_id: int,
+    jump_cap: int = JUMP_CAP,
+) -> tuple:
+    """Forced-run tables from dense allow/transition tables.
+
+    A state forces a token when its allow row admits EXACTLY one token
+    and that token is not EOS — accepting states admit EOS beside any
+    byte edges, so a forced state is never accepting and a jump can
+    never skip over a legal stop point. Chains of forced states
+    collapse into one run, truncated at jump_cap (the per-state walk is
+    bounded, so forced cycles — impossible in a terminating JSON
+    grammar anyway — cannot hang compilation)."""
+    n = allow.shape[0]
+    jump_cap = max(0, int(jump_cap))
+    counts = allow.sum(axis=1)
+    single = np.where(counts == 1)[0]
+    # forced_tok[s] = the unique admissible token, or -1.
+    forced_tok = np.full((n,), -1, dtype=np.int64)
+    if len(single):
+        toks = allow[single].argmax(axis=1)
+        keep = toks != eos_id
+        forced_tok[single[keep]] = toks[keep]
+    jump_len = np.zeros((n,), dtype=np.int32)
+    jump_tokens = np.zeros((n, jump_cap), dtype=np.int32)
+    # Padding states = self, so `jump_states + base` stays in-range
+    # after arena relocation even for never-read cells.
+    jump_states = np.tile(
+        np.arange(n, dtype=np.int32)[:, None], (1, max(1, jump_cap))
+    )[:, :jump_cap]
+    for sid in range(n):
+        s = sid
+        length = 0
+        while length < jump_cap and forced_tok[s] >= 0:
+            tok = int(forced_tok[s])
+            s = int(trans[s, tok])
+            jump_tokens[sid, length] = tok
+            jump_states[sid, length] = s
+            length += 1
+        jump_len[sid] = length
+        # Landing-state padding: cells past the run read as the landing
+        # state, which keeps truncated-window lookups well-defined.
+        if length:
+            jump_states[sid, length:] = s
+    return jump_len, jump_tokens, jump_states
+
+
 def compile_schema(
     schema: "str | dict",
     vocab_size: int,
     eos_id: int = 2,
     max_states: int = 1024,
     byte_offset: int = 3,
+    jump_cap: int = JUMP_CAP,
 ) -> CompiledGrammar:
     """Compile a JSON schema into a CompiledGrammar.
 
@@ -502,11 +578,17 @@ def compile_schema(
             trans[sid, b + byte_offset] = dst
         if accept[sid] and not dfa_edges[sid]:
             sink[sid] = True
+    jump_len, jump_tokens, jump_states = compute_jump_tables(
+        allow, trans, eos_id, jump_cap
+    )
     return CompiledGrammar(
         allow=allow,
         trans=trans,
         accept=accept,
         sink=sink,
+        jump_len=jump_len,
+        jump_tokens=jump_tokens,
+        jump_states=jump_states,
         n_states=n,
         schema_hash=schema_fingerprint(parsed),
         vocab_size=vocab_size,

@@ -114,13 +114,26 @@ class GrammarArena:
     batcher knows when to re-upload to device.
     """
 
-    def __init__(self, max_states: int, vocab_size: int):
+    def __init__(self, max_states: int, vocab_size: int,
+                 jump_max: int = 0):
         self.max_states = max(2, int(max_states))
         self.vocab_size = int(vocab_size)
+        self.jump_max = max(0, int(jump_max))
         self.allow = np.zeros((self.max_states, self.vocab_size), dtype=bool)
         self.allow[0, :] = True  # state 0: unconstrained rows
         self.trans = np.zeros((self.max_states, self.vocab_size), np.int32)
         self.sink = np.zeros((self.max_states,), dtype=bool)
+        # Forced-run tables (jump-ahead decoding), same fixed-shape
+        # residency rows as allow/trans: per-state run length (clipped
+        # to jump_max), run token ids, and absolute landing states.
+        # State 0 (and every unoccupied row) has jump_len 0, so
+        # unconstrained/parked rows never jump. jump_states cells
+        # default to 0 — a valid absolute state — so a stale row can
+        # never index out of the arena.
+        width = max(1, self.jump_max)
+        self.jump_len = np.zeros((self.max_states,), np.int32)
+        self.jump_tokens = np.zeros((self.max_states, width), np.int32)
+        self.jump_states = np.zeros((self.max_states, width), np.int32)
         self.version = 1
         self._lock = threading.Lock()
         # schema hash → [handle-agnostic entry]
@@ -142,11 +155,24 @@ class GrammarArena:
     def is_sink(self, state: int) -> bool:
         return bool(self.sink[state])
 
-    def snapshot(self) -> tuple[np.ndarray, np.ndarray, int]:
-        """(allow copy, trans copy, version) for device upload —
-        copied under the lock so an in-flight acquire can't tear it."""
+    def snapshot(self) -> tuple:
+        """(allow, trans, jump_len, jump_tokens, jump_states, version)
+        copies for device upload — copied under the lock so an
+        in-flight acquire can't tear them."""
         with self._lock:
-            return self.allow.copy(), self.trans.copy(), self.version
+            return (
+                self.allow.copy(), self.trans.copy(),
+                self.jump_len.copy(), self.jump_tokens.copy(),
+                self.jump_states.copy(), self.version,
+            )
+
+    def forced_run(self, state: int) -> list:
+        """Forced token run from an ABSOLUTE state, clipped to the
+        arena's jump_max — the host-side mirror of the device jump
+        (collect-side validation and replay re-derivation). Lock-free
+        for the same reason step() is."""
+        length = int(self.jump_len[state])
+        return [int(t) for t in self.jump_tokens[state, :length]]
 
     # -- residency ----------------------------------------------------------
 
@@ -185,6 +211,7 @@ class GrammarArena:
             self.allow[base:base + n] = grammar.allow
             self.trans[base:base + n] = grammar.trans + base
             self.sink[base:base + n] = grammar.sink
+            self._install_jump(grammar, base, n)
             self.version += 1
             self._entries[grammar.schema_hash] = {
                 "base": base, "n": n, "refs": 1, "stamp": self._clock,
@@ -200,6 +227,30 @@ class GrammarArena:
                 entry["refs"] -= 1
 
     # -- internals (lock held) ----------------------------------------------
+
+    def _install_jump(self, grammar: CompiledGrammar, base: int,
+                      n: int) -> None:
+        """Relocate the grammar's forced-run tables into rows
+        [base, base+n): run lengths clip to the arena's serving-time
+        window (jump_max), token columns pad with 0 and state columns
+        pad with the landing state (compiler padding convention), and
+        states relocate by `+ base` exactly like trans."""
+        if self.jump_max == 0:
+            return  # jump-ahead off: tables stay all-zero
+        width = self.jump_tokens.shape[1]
+        cap = grammar.jump_tokens.shape[1]
+        self.jump_len[base:base + n] = np.minimum(
+            grammar.jump_len, width
+        ).astype(np.int32)
+        take = min(cap, width)
+        jt = np.zeros((n, width), np.int32)
+        js = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, width))
+        jt[:, :take] = grammar.jump_tokens[:, :take]
+        js[:, :take] = grammar.jump_states[:, :take]
+        if take and width > take:
+            js[:, take:] = grammar.jump_states[:, take - 1:take]
+        self.jump_tokens[base:base + n] = jt
+        self.jump_states[base:base + n] = js + base
 
     def _find_gap(self, n: int) -> Optional[int]:
         """First contiguous free range of >= n states after state 0."""

@@ -393,9 +393,23 @@ def attention_block(
             if isinstance(cache_k, QuantizedArray) else cache_k.shape[1]
         )
         width = page_table.shape[1]
+        n_pg = (
+            cache_k.q.shape[0]
+            if isinstance(cache_k, QuantizedArray) else cache_k.shape[0]
+        )
         write_pos = cache_len[:, None] + jnp.arange(s)[None, :]  # [B, S]
-        w_page = jnp.take_along_axis(
-            page_table, jnp.minimum(write_pos // p_sz, width - 1), axis=1
+        w_idx = write_pos // p_sz
+        # Positions past the table width map to the sentinel, NOT to a
+        # clipped last entry: a multi-position window (jump tick,
+        # chunked prefill tail) can overshoot a full-width row's table,
+        # and clipping would land junk in that row's last REAL page.
+        # Sentinel writes drop (mode="drop"), same as unmapped entries.
+        w_page = jnp.where(
+            w_idx < width,
+            jnp.take_along_axis(
+                page_table, jnp.minimum(w_idx, width - 1), axis=1
+            ),
+            n_pg,
         )
         w_off = write_pos % p_sz
         if isinstance(cache_k, QuantizedArray):

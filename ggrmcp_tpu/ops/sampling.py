@@ -184,6 +184,37 @@ def masked_sample_dynamic(
     return tokens, nxt
 
 
+def forced_run_lookup(
+    state: jnp.ndarray,        # [B] int32 — per-row grammar state
+    jump_len: jnp.ndarray,     # [S] int32 — forced-run length per state
+    jump_tokens: jnp.ndarray,  # [S, J] int32 — run token ids
+    jump_states: jnp.ndarray,  # [S, J] int32 — absolute states along the run
+    jump_ok: jnp.ndarray,      # [B] bool — per-slot jump enable
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-row forced-run gather for the jump-ahead tick
+    (docs/structured_output.md "Jump-ahead"): returns
+    (run_len [B], run_tokens [B, J], landing [B]). run_len is 0 for
+    unconstrained rows (state 0 has no forced run) and for rows with
+    jump_ok=False (parked slots, jump-degraded requests — the
+    grammar_jump_fail fallback), which collapses the jump to plain
+    one-token constrained decoding for that row. landing is the
+    absolute DFA state after consuming the run (= state when run_len
+    is 0) — the state the post-run sample is masked under. Pure
+    gathers over the fixed-shape arena tables: shape-invariant across
+    any schema mix."""
+    length = jnp.where(jump_ok, jump_len[state], 0)
+    run_tokens = jump_tokens[state]  # [B, J]
+    landing = jnp.where(
+        length > 0,
+        jnp.take_along_axis(
+            jump_states[state],
+            jnp.maximum(length - 1, 0)[:, None], axis=-1,
+        )[:, 0],
+        state,
+    )
+    return length, run_tokens, landing
+
+
 def _mask_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
     k = min(k, logits.shape[-1])
     threshold = jax.lax.top_k(logits, k)[0][..., -1:]

@@ -361,6 +361,8 @@ def spec_tick(
     gstate: jnp.ndarray,  # [B] grammar DFA state (0 = unconstrained)
     g_allow: jnp.ndarray,  # [S, V] bool shared grammar allow table
     g_trans: jnp.ndarray,  # [S, V] int32 shared transition table
+    j_len=None,  # [S] int32 forced-run lengths (None: no jump seeding)
+    j_tokens=None,  # [S, J] int32 forced-run token ids
 ):
     """One FIXED-SHAPE draft/verify round over a continuous-batcher slot
     pool (the batching.speculative=on tick body, serving/batching.py).
@@ -388,6 +390,16 @@ def spec_tick(
         proposal distribution AND every verify position, with states
         advanced along the proposal path, so the emitted sequence obeys
         the grammar exactly as the plain masked tick would.
+
+    Jump seeding (grammar.jump_max > 0; docs/structured_output.md
+    "Jump-ahead"): when the forced-run tables are passed, a proposal
+    position whose DFA state forces exactly one token takes that token
+    straight from the table instead of sampling it — a forced run is a
+    free 100%-acceptance draft prefix. The allow-mask already leaves a
+    single finite logit in forced states, so the override changes no
+    emitted token (and no acceptance outcome); it makes the forced
+    prefix table-driven rather than argmax-recovered, and q(x)=1 for
+    forced positions holds exactly by construction.
 
     Parked (inactive) rows run junk like the plain tick; the host drops
     their tokens and admission re-stamps their state on slot reuse.
@@ -422,11 +434,18 @@ def spec_tick(
             lambda k: jax.random.gumbel(k, (masked.shape[-1],))
         )(fold(tag))
         samp = jnp.argmax(qlogp + g, axis=-1)
-        return (
-            jnp.where(sampled, samp, jnp.argmax(masked, axis=-1))
-            .astype(jnp.int32),
-            qlogp,
+        tok = jnp.where(sampled, samp, jnp.argmax(masked, axis=-1)).astype(
+            jnp.int32
         )
+        if j_len is not None:
+            # Forced-prefix seeding: a forced state's single admissible
+            # token comes straight from the run table — the free
+            # 100%-acceptance draft (identical to the masked draw, by
+            # the single-finite-logit argument above).
+            tok = jnp.where(
+                j_len[state] > 0, j_tokens[state, 0], tok
+            ).astype(jnp.int32)
+        return tok, qlogp
 
     def advance(state, tok):
         return jnp.take_along_axis(

@@ -37,6 +37,7 @@ from ggrmcp_tpu.models import llama as llama_mod
 from ggrmcp_tpu.ops import quant
 from ggrmcp_tpu.ops.sampling import (
     SamplingConfig,
+    forced_run_lookup,
     masked_sample_dynamic,
     sample_dynamic,
 )
@@ -230,6 +231,13 @@ class _Request:
     grammar: Optional[GrammarHandle] = None
     gcur: int = 0
     g_released: bool = False
+    # Jump-ahead degrade flag (docs/structured_output.md "Jump-ahead"):
+    # set when the collect-side validator refused one of this request's
+    # forced runs (grammar_jump_fail chaos / corrupted tables). The
+    # replayed request re-admits with jump_ok False and finishes under
+    # plain one-token constrained decoding — typed, counted, never
+    # silent.
+    jump_degraded: bool = False
 
 
 class ContinuousBatcher:
@@ -313,9 +321,57 @@ class ContinuousBatcher:
             max(1, int(getattr(engine.serving, "speculative_gamma", 4)))
             if self._spec else 0
         )
-        advance = self._gamma + 1 if self._spec else self._steps_per_tick
+        # Jump-ahead constrained decoding (serving.grammar.jump_max,
+        # docs/structured_output.md "Jump-ahead"): when a slot's DFA
+        # state forces a token run, the tick emits up to jump_max
+        # forced tokens plus one sampled token in ONE multi-position
+        # forward. The per-tick advance bound widens to 1 + jump_max
+        # for grammar-carrying requests, so THEIR overshoot reserve
+        # (fit_request and the whole-lifetime paged admission extent)
+        # re-derives from it — forced-run KV writes land in positions
+        # the slot already owns. Unconstrained requests keep the plain
+        # steps_per_tick reserve: they can never jump, and widening
+        # pool-wide would tax every workload's cache capacity for a
+        # window only constrained rows use (their surplus positions in
+        # a jump tick are junk that the write path's sentinel/OOB drop
+        # semantics discard — see models/llama.py paged scatter).
+        # Spec mode keeps its own gamma+1 window (forced runs ride the
+        # draft proposal there, not a wider verify). Ring mode is out:
+        # its clobber bound was sized for the prefill chunk, not a
+        # decode-side window.
+        gcfg = getattr(engine.serving, "grammar", None) or GrammarConfig()
+        jump_max = (
+            max(0, int(getattr(gcfg, "jump_max", 0))) if gcfg.enabled else 0
+        )
+        if jump_max and engine.ring_capacity is not None:
+            logger.warning(
+                "grammar.jump_max > 0 does not compose with kv_ring; "
+                "falling back to one-token constrained decoding"
+            )
+            jump_max = 0
+        if jump_max and getattr(engine, "fam", llama_mod) is not llama_mod:
+            # MoE routing is batch-global: junk window positions past a
+            # row's run would compete for expert capacity and perturb
+            # live rows — the same reason spec_tick is dense-only.
+            logger.warning(
+                "grammar.jump_max > 0 is dense-family only; falling "
+                "back to one-token constrained decoding"
+            )
+            jump_max = 0
+        self._jump_max = jump_max
+        if self._spec:
+            advance = jump_advance = self._gamma + 1
+        else:
+            advance = self._steps_per_tick
+            jump_advance = max(advance, 1 + self._jump_max)
         self._reserve = (
             2 * advance - 1 if self._pipeline else advance - 1
+        )
+        # Per-request widened twin of _reserve (== _reserve when jump
+        # is off or under spec): _reserve_for picks between them by
+        # grammar presence at every fit/clamp/admission site.
+        self._jump_reserve = (
+            2 * jump_advance - 1 if self._pipeline else jump_advance - 1
         )
         # In-flight dispatched-not-yet-collected ticks, oldest first:
         # (tokens [B, steps] device array, per-slot owner snapshot).
@@ -476,17 +532,35 @@ class ContinuousBatcher:
         # (_grammar_tables).
         self.gstates = np.zeros((b,), np.int32)
         self._gstate_dev = None
-        gcfg = getattr(engine.serving, "grammar", None) or GrammarConfig()
         self.arena = GrammarArena(
             gcfg.arena_states if gcfg.enabled else 2,
             engine.cfg.vocab_size,
+            jump_max=self._jump_max,
         )
         self._g_allow_dev = None
         self._g_trans_dev = None
+        self._g_jlen_dev = None
+        self._g_jtok_dev = None
+        self._g_jstate_dev = None
         self._g_dev_version = -1
         # Tokens emitted under an active grammar mask (the
         # grammar_masked_tokens ServingStats field).
         self.grammar_tokens = 0
+        # Jump-ahead accounting (grammar_jump_* ServingStats fields):
+        # forced tokens emitted by multi-token advances, jump ticks
+        # that advanced at least one run, and runs the collect-side
+        # validator refused (grammar_jump_fail chaos / corrupted
+        # tables) — each fallback degrades that request typed to plain
+        # one-token constrained decoding, never silently.
+        self.grammar_jump_tokens = 0
+        self.grammar_jump_runs = 0
+        self.grammar_jump_fallbacks = 0
+        # Per-slot jump enable, stamped at activation like temps:
+        # True only while the slot serves a constrained request that
+        # has not been jump-degraded. Host array, shipped with each
+        # jump dispatch — parked rows read False, so stale device
+        # grammar states can never jump a dead slot's length pointer.
+        self.jump_ok = np.zeros((b,), bool)
         self.temps = np.zeros((b,), np.float32)
         self.top_ks = np.zeros((b,), np.int32)
         self.top_ps = np.ones((b,), np.float32)
@@ -680,6 +754,21 @@ class ContinuousBatcher:
             self._spec_admit = jax.jit(
                 self._spec_admit_impl, donate_argnums=(3,)
             )
+        # Jump-ahead tick programs (grammar.jump_max > 0,
+        # docs/structured_output.md "Jump-ahead"): one decode forward
+        # over a static [B, 1 + jump_max] window emits each row's
+        # forced token run plus one sampled token — shape-invariant
+        # across any schema mix (the window width is `jump_max`, a
+        # constructor constant, never a data-dependent run length).
+        # The chunk variant fuses one interleaved-admission prefill
+        # chunk exactly like _tick_chunk does.
+        if self._jump_max:
+            self._tick_jump = jax.jit(
+                self._tick_jump_impl, donate_argnums=(2,)
+            )
+            self._tick_jump_chunk = jax.jit(
+                self._tick_jump_chunk_impl, donate_argnums=(2, 11)
+            )
         # Device-memory ledger (serving/memory_ledger.py,
         # docs/observability.md): every persistent device allocation
         # this batcher owns registers a named component on the ENGINE's
@@ -709,7 +798,10 @@ class ContinuousBatcher:
         )
         engine.ledger.register(
             "grammar_arena",
-            lambda: (self._g_allow_dev, self._g_trans_dev),
+            lambda: (
+                self._g_allow_dev, self._g_trans_dev,
+                self._g_jlen_dev, self._g_jtok_dev, self._g_jstate_dev,
+            ),
             scope=ledger_scope,
         )
         engine.ledger.register(
@@ -829,8 +921,16 @@ class ContinuousBatcher:
 
     # -- KV page export/import (sidecar→sidecar TransferKV plane) -----------
 
+    def _reserve_for(self, constrained: bool) -> int:
+        """The tick-overshoot reserve a request's cache extent must
+        cover: grammar-carrying requests reserve the jump window
+        (1 + jump_max positions may be written in one jump tick),
+        unconstrained requests only the plain per-tick advance. Both
+        values are identical when jump is off or under spec mode."""
+        return self._jump_reserve if constrained else self._reserve
+
     def clamp_prompt(
-        self, prompt: list[int], max_new: int
+        self, prompt: list[int], max_new: int, constrained: bool = False
     ) -> list[int]:
         """The prompt exactly as an admission for (prompt, max_new)
         will see it (fit_request keeps the TAIL, sized by max_new and
@@ -838,9 +938,11 @@ class ContinuousBatcher:
         admit and export THIS prompt — with the request's real max_new,
         not its own 1-token one — or a near-limit prompt would register
         a different chain than the decode replica's identically clamped
-        admission looks up."""
+        admission looks up. `constrained` must mirror whether the
+        request carries a grammar: the jump window widens a constrained
+        request's reserve, so both disagg legs have to agree on it."""
         clamped, _ = fit_request(
-            prompt, max_new, self._fit_limit - self._reserve
+            prompt, max_new, self._fit_limit - self._reserve_for(constrained)
         )
         return clamped
 
@@ -1055,9 +1157,16 @@ class ContinuousBatcher:
             self._g_allow_dev is None
             or self._g_dev_version != self.arena.version
         ):
-            allow, trans, version = self.arena.snapshot()
+            (allow, trans, jlen, jtok, jstate,
+             version) = self.arena.snapshot()
             self._g_allow_dev = self._snap_dev(allow)
             self._g_trans_dev = self._snap_dev(trans)
+            # Forced-run twins ride the same version gate: a jump tick
+            # dispatched after any acquire sees relocated run tables
+            # consistent with the allow/trans pair it masks under.
+            self._g_jlen_dev = self._snap_dev(jlen)
+            self._g_jtok_dev = self._snap_dev(jtok)
+            self._g_jstate_dev = self._snap_dev(jstate)
             self._g_dev_version = version
         return self._g_allow_dev, self._g_trans_dev
 
@@ -1377,6 +1486,100 @@ class ContinuousBatcher:
         )
         return toks, cache, mini, sel, gstate
 
+    def _jump_core(
+        self, params, tokens, cache, seeds, step, temps, ks, ps,
+        adapters, gstate, g_allow, g_trans, j_len, j_tok, j_state,
+        jump_ok,
+    ):
+        """The jump-ahead advance (docs/structured_output.md
+        "Jump-ahead"): ONE decode forward over a static
+        [B, 1 + jump_max] window = each row's pending token plus its
+        forced run, then one grammar-masked sample under the run's
+        landing state. Shape-invariant across any schema mix — the
+        window width is the constructor's jump_max, never a
+        data-dependent run length; rows without a forced run (state 0,
+        jump_ok False, parked slots) read run_len 0 and collapse to the
+        plain one-token constrained step, their surplus window
+        positions junk that dies under the causal length mask exactly
+        like spec_tick's rejected verify positions (only the length
+        POINTER advances by 1 + run_len; the forward wrote all
+        1 + jump_max). Forced tokens get real KV writes from the same
+        forward that samples the landing token — "emit without a
+        forward pass" means no per-token forward, not no KV.
+
+        Returns (emit [B, 1+jump_max], count [B], cache, cur' [B],
+        gstate' [B]); the host emits emit[i, :count[i]] per owned row,
+        count = run_len + 1 in [1, 1 + jump_max].
+        """
+        tlen0 = cache.length
+        run_len, run_tokens, landing = forced_run_lookup(
+            gstate, j_len, j_tok, j_state, jump_ok
+        )
+        window = jnp.concatenate([tokens[:, None], run_tokens], axis=1)
+        # Dense families only (the constructor gates jump off for MoE:
+        # batch-global expert routing would see the junk window
+        # positions) — no validity mask needed, like spec_tick.
+        logits, cache = self.engine.decode_forward(
+            params, window, cache, ring=self._ring, lora_idx=adapters,
+        )
+        # logits[:, i] predicts the token AFTER window[:, :i+1] — the
+        # post-run sample reads position run_len (0 when no run: the
+        # plain tick's gather).
+        sel = jnp.take_along_axis(
+            logits, run_len[:, None, None], axis=1
+        )[:, 0]
+        nxt, gstate2 = masked_sample_dynamic(
+            sel, seeds, step, temps, ks, ps, landing, g_allow, g_trans,
+        )
+        idx = jnp.arange(window.shape[1])[None, :]
+        emit = jnp.where(
+            idx < run_len[:, None],
+            jnp.pad(run_tokens, ((0, 0), (0, 1))),
+            jnp.where(idx == run_len[:, None], nxt[:, None], 0),
+        )
+        count = run_len + 1
+        # Commit cur + the forced run; the sampled token is the next
+        # tick's pending feed (its KV unwritten, the plain-tick
+        # invariant).
+        cache = cache._replace(length=tlen0 + count)
+        return emit, count, cache, nxt, gstate2
+
+    def _tick_jump_impl(
+        self, params, tokens, cache, seeds, step, temps, ks, ps, active,
+        adapters, gstate, g_allow, g_trans, j_len, j_tok, j_state,
+        jump_ok,
+    ):
+        """One jump-ahead device call for the whole slot pool — the
+        multi-token twin of _tick_impl, dispatched instead of it while
+        any live slot can jump (_tick_step)."""
+        del active  # dense-only path; kept for dispatch symmetry
+        return self._jump_core(
+            params, tokens, cache, seeds, step, temps, ks, ps,
+            adapters, gstate, g_allow, g_trans, j_len, j_tok, j_state,
+            jump_ok,
+        )
+
+    def _tick_jump_chunk_impl(
+        self, params, tokens, cache, seeds, step, temps, ks, ps, active,
+        adapters, chunk, mini, offs, c_true_len, c_valid, c_adapters,
+        gstate, g_allow, g_trans, j_len, j_tok, j_state, jump_ok,
+    ):
+        """_tick_jump_impl fused with one [K, C] interleaved-admission
+        prefill chunk — the jump path rides the existing chunked-
+        prefill machinery the same way _tick_chunk_impl does, so a
+        forced run never serializes against a long prompt's
+        admission."""
+        del active
+        emit, count, cache, cur2, gstate2 = self._jump_core(
+            params, tokens, cache, seeds, step, temps, ks, ps,
+            adapters, gstate, g_allow, g_trans, j_len, j_tok, j_state,
+            jump_ok,
+        )
+        mini, sel = self._chunk_extend(
+            params, chunk, mini, offs, c_true_len, c_valid, c_adapters
+        )
+        return emit, count, cache, cur2, gstate2, mini, sel
+
     def _chunk_extend(
         self, params, chunk, mini, offs, c_true_len, c_valid, c_adapters
     ):
@@ -1404,10 +1607,14 @@ class ContinuousBatcher:
 
     def _spec_round(
         self, params, draft_params, prev, tokens, cache, dcache, seeds,
-        step, temps, ks, ps, gstate, g_allow, g_trans,
+        step, temps, ks, ps, gstate, g_allow, g_trans, j_len, j_tok,
     ):
         """One fixed-shape draft/verify round over the slot pool
-        (ops/speculative.spec_tick wired to this engine's forwards)."""
+        (ops/speculative.spec_tick wired to this engine's forwards).
+        j_len/j_tok are the arena's forced-run tables (None when
+        grammar.jump_max is 0): a forced run seeds the draft's proposal
+        prefix as a free 100%-acceptance draft — see spec_tick's "Jump
+        seeding" note."""
         from ggrmcp_tpu.ops.speculative import spec_tick
 
         return spec_tick(
@@ -1417,11 +1624,12 @@ class ContinuousBatcher:
             lambda t, c: self.engine.draft_forward(draft_params, t, c),
             prev, tokens, cache, dcache, self._gamma, seeds, step,
             temps, ks, ps, gstate, g_allow, g_trans,
+            j_len=j_len, j_tokens=j_tok,
         )
 
     def _tick_spec_impl(
         self, params, draft_params, prev, tokens, cache, dcache, seeds,
-        step, temps, ks, ps, gstate, g_allow, g_trans,
+        step, temps, ks, ps, gstate, g_allow, g_trans, j_len, j_tok,
     ):
         """The speculative tick: ONE device call = gamma draft steps +
         one (gamma+1)-position target verify for every slot. Returns
@@ -1430,13 +1638,14 @@ class ContinuousBatcher:
         variable advance, fixed shapes (docs/speculative.md)."""
         return self._spec_round(
             params, draft_params, prev, tokens, cache, dcache, seeds,
-            step, temps, ks, ps, gstate, g_allow, g_trans,
+            step, temps, ks, ps, gstate, g_allow, g_trans, j_len, j_tok,
         )
 
     def _tick_spec_chunk_impl(
         self, params, draft_params, prev, tokens, cache, dcache, seeds,
         step, temps, ks, ps, gstate, g_allow, g_trans,
         chunk, mini, offs, c_true_len, c_valid, c_adapters,
+        j_len, j_tok,
     ):
         """_tick_spec_impl fused with one [K, C] interleaved-admission
         prefill chunk — spec mode composes with prefill_interleave the
@@ -1445,6 +1654,7 @@ class ContinuousBatcher:
             self._spec_round(
                 params, draft_params, prev, tokens, cache, dcache,
                 seeds, step, temps, ks, ps, gstate, g_allow, g_trans,
+                j_len, j_tok,
             )
         )
         mini, sel = self._chunk_extend(
@@ -1878,6 +2088,13 @@ class ContinuousBatcher:
         self.top_ps[slot_idx] = request.sampling.top_p
         self.seeds[slot_idx] = request.seed & 0xFFFFFFFF
         self.adapter_ids[slot_idx] = request.adapter
+        # Jump-ahead eligibility: only a live constrained request that
+        # has not been jump-degraded may multi-token advance.
+        self.jump_ok[slot_idx] = bool(
+            self._jump_max
+            and request.grammar is not None
+            and not request.jump_degraded
+        )
         # Paged KV: the prompt's full pages now hold valid prefix KV
         # (activation implies the prefill materialized) — index them so
         # later admissions share instead of recomputing. Adapter'd rows
@@ -1917,6 +2134,13 @@ class ContinuousBatcher:
         # Grammar tables ride every sampling program as fixed-shape
         # args; state 0 (accept-all) keeps warmup numerics inert.
         g_allow, g_trans = self._grammar_tables()
+        # Forced-run twins for the jump/spec programs (uploaded by the
+        # _grammar_tables call above; None when jump-ahead is off keeps
+        # the no-jump spec trace).
+        spec_jargs = (
+            (self._g_jlen_dev, self._g_jtok_dev)
+            if self._jump_max else (None, None)
+        )
         zgb = np.zeros((b,), np.int32)
         _, self.cache = self._admit_single(
             self.engine.params, jnp.asarray(zeros1), jnp.asarray(zlen1),
@@ -1957,6 +2181,7 @@ class ContinuousBatcher:
                 jnp.asarray(self.temps), jnp.asarray(self.top_ks),
                 jnp.asarray(self.top_ps),
                 self._snap_dev(self.gstates), g_allow, g_trans,
+                *spec_jargs,
             )
             for r_rows in (1, b) if b > 1 else (1,):
                 self.dcache = self._spec_admit(
@@ -1977,6 +2202,27 @@ class ContinuousBatcher:
                 jnp.asarray(np.zeros((b,), np.int32)),
                 self._snap_dev(self.gstates), g_allow, g_trans,
             )
+            if self._jump_max:
+                # The jump tick alternates with the plain tick at
+                # dispatch time (jump only while some slot can jump) —
+                # BOTH must be warm or the first constrained request
+                # pays a post-warmup compile (compile-watcher contract).
+                # All-False jump_ok: every row runs a zero-length run,
+                # advancing length pointers by 1 like the plain tick —
+                # harmless pre-serving.
+                _, _, self.cache, _, _ = self._tick_jump(
+                    self.engine.params, self._snap_dev(self.cur_tokens),
+                    self.cache,
+                    jnp.asarray(self.seeds), jnp.int32(0),
+                    jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+                    jnp.asarray(self.top_ps),
+                    jnp.asarray(np.zeros((b,), bool)),
+                    jnp.asarray(np.zeros((b,), np.int32)),
+                    self._snap_dev(self.gstates), g_allow, g_trans,
+                    self._g_jlen_dev, self._g_jtok_dev,
+                    self._g_jstate_dev,
+                    jnp.asarray(np.zeros((b,), bool)),
+                )
         # Fused chunked-admission programs. The long-prompt grid
         # ([B, T, C]) compiles per distinct T — warm the single-chunk
         # grid when the chunked path is reachable (deeper grids compile
@@ -2051,6 +2297,7 @@ class ContinuousBatcher:
                     jnp.asarray(np.ones((k_rows,), np.int32)),
                     jnp.asarray(np.zeros((k_rows,), bool)),
                     jnp.asarray(np.zeros((k_rows,), np.int32)),
+                    *spec_jargs,
                 )
             else:
                 _, self.cache, self._ilv_mini, sel, _ = self._tick_chunk(
@@ -2068,6 +2315,32 @@ class ContinuousBatcher:
                     jnp.asarray(np.zeros((k_rows,), np.int32)),
                     self._snap_dev(self.gstates), g_allow, g_trans,
                 )
+                if self._jump_max:
+                    # Jump + interleave composes (same alternating-
+                    # dispatch reasoning as the plain/jump pair above).
+                    (
+                        _, _, self.cache, _, _, self._ilv_mini, sel
+                    ) = self._tick_jump_chunk(
+                        self.engine.params,
+                        self._snap_dev(self.cur_tokens),
+                        self.cache, jnp.asarray(self.seeds),
+                        jnp.int32(0),
+                        jnp.asarray(self.temps),
+                        jnp.asarray(self.top_ks),
+                        jnp.asarray(self.top_ps),
+                        jnp.asarray(np.zeros((b,), bool)),
+                        jnp.asarray(np.zeros((b,), np.int32)),
+                        jnp.asarray(np.zeros((k_rows, c), np.int32)),
+                        self._ilv_mini,
+                        jnp.asarray(np.zeros((k_rows,), np.int32)),
+                        jnp.asarray(np.ones((k_rows,), np.int32)),
+                        jnp.asarray(np.zeros((k_rows,), bool)),
+                        jnp.asarray(np.zeros((k_rows,), np.int32)),
+                        self._snap_dev(self.gstates), g_allow, g_trans,
+                        self._g_jlen_dev, self._g_jtok_dev,
+                        self._g_jstate_dev,
+                        jnp.asarray(np.zeros((b,), bool)),
+                    )
             _, self.cache = self._ilv_finish(
                 self.cache, self._ilv_mini, jnp.int32(0), jnp.int32(0),
                 jnp.int32(0), sel, jnp.asarray(zseed1),
@@ -2344,9 +2617,12 @@ class ContinuousBatcher:
         # Reserve cache positions for tick overshoot: a tick may run
         # past a slot's max_new by up to steps_per_tick-1 positions
         # before the host masks the extra tokens — one further full
-        # tick under pipelining (emission lags the dispatch by a tick).
+        # tick under pipelining (emission lags the dispatch by a tick),
+        # and up to jump_max further positions when this request's
+        # grammar lets a jump tick write a forced run (_reserve_for).
         prompt, max_new = fit_request(
-            prompt, max_new, self._fit_limit - self._reserve
+            prompt, max_new,
+            self._fit_limit - self._reserve_for(grammar is not None),
         )
         cap = self.cfg.max_pending
         if cap > 0 and self.pending.qsize() >= cap:
@@ -2597,6 +2873,15 @@ class ContinuousBatcher:
             # adds the compile/cache-hit counters from its GrammarCache.
             "grammar_masked_tokens": self.grammar_tokens,
             "grammar_states_in_use": self.arena.states_in_use(),
+            # Jump-ahead constrained decoding (grammar.jump_max > 0):
+            # forced tokens emitted by multi-token advances, runs
+            # advanced, and runs the collect-side validator refused
+            # (each one a typed degrade to one-token decoding).
+            # grammar_jump_tokens / grammar_masked_tokens is the
+            # forced-token fraction (docs/observability.md).
+            "grammar_jump_tokens": self.grammar_jump_tokens,
+            "grammar_jump_runs": self.grammar_jump_runs,
+            "grammar_jump_fallbacks": self.grammar_jump_fallbacks,
             # Per-tick timing breakdown (cumulative ms + counts):
             # dispatch = host-side tick launch, collect = blocking
             # token pull (device wait + transfer), admit = full
@@ -2796,6 +3081,7 @@ class ContinuousBatcher:
         self._cur_dev = None
         self.adapter_ids[:] = 0
         self.gstates[:] = 0
+        self.jump_ok[:] = False
         self._gstate_dev = None
         if self._paged:
             # The donated arena died with the tick: every page and
@@ -3081,7 +3367,8 @@ class ContinuousBatcher:
                     # lifted (serving/pages.py key-domain test).
                     adm = self.pages.admit(
                         sl, req.prompt,
-                        len(req.prompt) + req.max_new + self._reserve + 1,
+                        len(req.prompt) + req.max_new
+                        + self._reserve_for(req.grammar is not None) + 1,
                         adapter=req.adapter_key,
                     )
                 except (PageExhaustedError, failpoints.FailpointError):
@@ -3440,6 +3727,13 @@ class ContinuousBatcher:
                 self._tick_spec_dispatch(chunk=True)
             else:
                 self._tick_spec_dispatch()
+        elif self._jump_max and bool(self.jump_ok.any()):
+            # Jump-ahead tick only while some live slot can actually
+            # jump (a constrained, non-degraded request): unconstrained
+            # workloads keep the plain tick's steps_per_tick scan and
+            # pay ZERO jump overhead. Both program families are warmed,
+            # so alternating dispatchers never recompiles.
+            self._tick_dispatch_jump(chunk=self._ilv_busy())
         elif self._ilv_busy():
             self._tick_dispatch_chunk()
         else:
@@ -3515,7 +3809,7 @@ class ContinuousBatcher:
         # finish (tick N's emission) and be re-admitted before tick
         # N+1's junk row for the old request is collected.
         owners = [s.request if s.active else None for s in self.slots]
-        self._inflight.append((toks, None, owners, rec))
+        self._inflight.append((toks, None, owners, rec, "plain"))
         self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
         self.timing["ticks"] += 1
         if rec is not None:
@@ -3558,6 +3852,13 @@ class ContinuousBatcher:
             jnp.asarray(self.top_ps),
             self._gstate_dev, g_allow, g_trans,
         )
+        # Forced-run tables for the draft's jump seeding (None keeps
+        # the no-jump trace when grammar.jump_max is 0). Refreshed by
+        # _grammar_tables above, so they always match g_allow/g_trans.
+        jargs = (
+            (self._g_jlen_dev, self._g_jtok_dev)
+            if self._jump_max else (None, None)
+        )
         if chunk:
             (chunk_arr, offs, c_tl, c_valid, c_adapt) = (
                 self._ilv_chunk_inputs()
@@ -3574,7 +3875,7 @@ class ContinuousBatcher:
             ) = self._tick_spec_chunk(
                 *args, jnp.asarray(chunk_arr), self._ilv_mini,
                 jnp.asarray(offs), jnp.asarray(c_tl),
-                jnp.asarray(c_valid), jnp.asarray(c_adapt),
+                jnp.asarray(c_valid), jnp.asarray(c_adapt), *jargs,
             )
         else:
             if rec is not None:
@@ -3582,7 +3883,7 @@ class ContinuousBatcher:
             (
                 toks, counts, self.cache, self.dcache,
                 prev_out, cur_out, gstate_out,
-            ) = self._tick_spec(*args)
+            ) = self._tick_spec(*args, *jargs)
         self._cur_dev = cur_out
         self._prev_dev = prev_out
         self._gstate_dev = gstate_out
@@ -3592,7 +3893,7 @@ class ContinuousBatcher:
         except (AttributeError, RuntimeError):
             pass
         owners = [s.request if s.active else None for s in self.slots]
-        self._inflight.append((toks, counts, owners, rec))
+        self._inflight.append((toks, counts, owners, rec, "spec"))
         self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
         self.timing["ticks"] += 1
         self.spec_ticks += 1
@@ -3690,13 +3991,93 @@ class ContinuousBatcher:
         except (AttributeError, RuntimeError):
             pass
         owners = [s.request if s.active else None for s in self.slots]
-        self._inflight.append((toks, None, owners, rec))
+        self._inflight.append((toks, None, owners, rec, "plain"))
         self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
         self.timing["ticks"] += 1
         self._ilv_advance(sel)
         if rec is not None:
             # After _ilv_advance: a final chunk's row finish (one small
             # device call + activation) is dispatch-side host work.
+            rec.phases.mark("dispatch")
+
+    def _tick_dispatch_jump(self, chunk: bool = False) -> None:
+        """The jump-ahead twin of _tick_dispatch: one device call =
+        each row's forced run plus ONE sampled token (a static
+        [B, 1 + jump_max] window — _jump_core), fused with at most one
+        [K, C] interleaved prefill chunk when `chunk`. Token/grammar
+        feedback stays device-resident exactly like the plain tick;
+        the host pulls (emit, count) at collect, validates each run
+        against its own arena walk, and advances each slot by its run
+        length + 1."""
+        t0 = time.perf_counter()
+        step0 = self.step_counter
+        # 1 + jump_max positions processed per row; the sample's RNG
+        # tag (step0 + 1) stays unique across ticks.
+        self.step_counter += 1 + self._jump_max
+        active = np.array([s.active for s in self.slots], bool)
+        # Record first: the PhaseTimer must cover the host-state sync
+        # below (same contract as _tick_dispatch).
+        rec = self._tick_record(active)
+        if chunk:
+            self._ilv_fill_rows()
+        self._sync_tables()
+        if self._cur_dev is None:
+            self._cur_dev = self._snap_dev(self.cur_tokens)
+        if self._gstate_dev is None:
+            self._gstate_dev = self._snap_dev(self.gstates)
+        g_allow, g_trans = self._grammar_tables()
+        args = (
+            self.engine.params, self._cur_dev, self.cache,
+            jnp.asarray(self.seeds), jnp.int32(step0 + 1),
+            jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+            jnp.asarray(self.top_ps), jnp.asarray(active),
+            jnp.asarray(self.adapter_ids),
+        )
+        # jump_ok ships per dispatch (host-stamped, like temps): a
+        # parked slot's stale device grammar state can never advance a
+        # dead row's length pointer.
+        jargs = (
+            self._gstate_dev, g_allow, g_trans,
+            self._g_jlen_dev, self._g_jtok_dev, self._g_jstate_dev,
+            jnp.asarray(self.jump_ok),
+        )
+        if chunk:
+            if self._ilv_mini is None:
+                self._ilv_mini = self._make_mini(self._ilv_k, self.max_seq)
+            chunk_arr, offs, c_tl, c_valid, c_adapt = (
+                self._ilv_chunk_inputs()
+            )
+            if rec is not None:
+                rec.interleaved_rows = int(c_valid.sum())
+                rec.phases.mark("sync")
+            (
+                toks, counts, self.cache, cur_out, gstate_out,
+                self._ilv_mini, sel,
+            ) = self._tick_jump_chunk(
+                *args, jnp.asarray(chunk_arr), self._ilv_mini,
+                jnp.asarray(offs), jnp.asarray(c_tl),
+                jnp.asarray(c_valid), jnp.asarray(c_adapt), *jargs,
+            )
+        else:
+            if rec is not None:
+                rec.phases.mark("sync")
+            toks, counts, self.cache, cur_out, gstate_out = (
+                self._tick_jump(*args, *jargs)
+            )
+        self._cur_dev = cur_out
+        self._gstate_dev = gstate_out
+        try:
+            toks.copy_to_host_async()
+            counts.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        owners = [s.request if s.active else None for s in self.slots]
+        self._inflight.append((toks, counts, owners, rec, "jump"))
+        self.timing["tick_dispatch_ms"] += (time.perf_counter() - t0) * 1000.0
+        self.timing["ticks"] += 1
+        if chunk:
+            self._ilv_advance(sel)
+        if rec is not None:
             rec.phases.mark("dispatch")
 
     def _ilv_finish_row(self, r: int, sel) -> None:
@@ -3730,10 +4111,11 @@ class ContinuousBatcher:
         possibly re-admitted — since dispatch) are dropped: their
         tokens are the junk a parked slot keeps sampling."""
         t0 = time.perf_counter()
-        toks_dev, counts_dev, owners, rec = self._inflight.popleft()
-        toks = np.asarray(toks_dev)  # [B, steps_per_tick | gamma+1]
-        # counts is the spec tick's per-row accepted+1 (None on plain
-        # ticks): emission truncates to it, and accepted = count - 1.
+        toks_dev, counts_dev, owners, rec, kind = self._inflight.popleft()
+        toks = np.asarray(toks_dev)  # [B, steps_per_tick | gamma+1 | J+1]
+        # counts is the spec tick's per-row accepted+1 (or the jump
+        # tick's forced-run length + 1; None on plain ticks): emission
+        # truncates to it.
         counts = None if counts_dev is None else np.asarray(counts_dev)
         if rec is not None:
             # Everything since the dispatch mark was in-flight wait:
@@ -3744,10 +4126,11 @@ class ContinuousBatcher:
         self.timing["collects"] += 1
         finished = 0
         drafted = accepted = 0
+        jump_tokens = jump_runs = 0
         for i, request in enumerate(owners):
             if request is None:
                 continue
-            if counts is not None:
+            if kind == "spec":
                 drafted += self._gamma
                 accepted += int(counts[i]) - 1
             slot = self.slots[i]
@@ -3756,6 +4139,20 @@ class ContinuousBatcher:
             if counts is None:
                 self.cur_tokens[i] = toks[i, -1]
                 self._emit_chunk(i, toks[i])
+            elif kind == "jump":
+                c = int(counts[i])
+                if c > 1 and not self._jump_validate(i, request, toks, c):
+                    # Refused run (grammar_jump_fail chaos or corrupted
+                    # tables): nothing from this tick is delivered for
+                    # the row — the request replays typed and finishes
+                    # under plain one-token constrained decoding.
+                    self._jump_degrade(i, request)
+                    continue
+                if c > 1:
+                    jump_tokens += c - 1
+                    jump_runs += 1
+                self.cur_tokens[i] = toks[i, c - 1]
+                self._emit_chunk(i, toks[i, :c])
             else:
                 c = int(counts[i])
                 # Host mirrors trail the device twins (rebuild seeds
@@ -3768,11 +4165,14 @@ class ContinuousBatcher:
                 self._emit_chunk(i, toks[i, :c])
             if self.slots[i].request is not request:
                 finished += 1
-        if counts is not None:
+        if kind == "spec":
             self.spec_drafted += drafted
             self.spec_accepted += accepted
+        self.grammar_jump_tokens += jump_tokens
+        self.grammar_jump_runs += jump_runs
         self.recorder.tick_done(
-            rec, finished, spec_drafted=drafted, spec_accepted=accepted
+            rec, finished, spec_drafted=drafted, spec_accepted=accepted,
+            jump_tokens=jump_tokens, jump_runs=jump_runs,
         )
         if rec is not None:
             # Cumulative per-phase attribution (ServingStats
@@ -3780,6 +4180,56 @@ class ContinuousBatcher:
             # and the per-phase histograms always agree.
             for phase in PHASE_NAMES:
                 self.phase_ms[phase] += getattr(rec, f"phase_{phase}_ms")
+
+    def _jump_validate(self, slot_idx: int, request, toks, c: int) -> bool:
+        """Collect-side check of a jump tick's forced run for one row:
+        re-derive the run from the HOST arena walk at the request's
+        current DFA state and require the device's emitted run to match
+        it exactly. The host walk is the independent mirror (lock-free;
+        live rows are immutable while referenced), so a corrupted
+        device table or landing state is caught before a single bad
+        token reaches the consumer. The grammar_jump_fail failpoint
+        injects exactly that corruption (chaos suite)."""
+        try:
+            failpoints.evaluate("grammar_jump_fail")
+        except failpoints.FailpointError:
+            return False
+        expected = self.arena.forced_run(request.gcur)
+        return [int(t) for t in toks[slot_idx, : c - 1]] == expected
+
+    def _jump_degrade(self, slot_idx: int, request) -> None:
+        """A refused forced run degrades the request TYPED to plain
+        one-token constrained decoding — counted, logged, never silent.
+        The device row is unusable (its length pointer and grammar
+        state advanced through the refused run), so the slot parks and
+        the request replays through admission with its delivered prefix
+        (prompt + acc — the same machinery a tick failure uses), now
+        with jump_degraded set: the re-admission stamps jump_ok False
+        and the row single-steps to completion, its greedy output still
+        schema-valid because the allow-mask path never depended on the
+        run tables."""
+        self.grammar_jump_fallbacks += 1
+        request.jump_degraded = True
+        logger.warning(
+            "jump-ahead: forced run refused for slot %d; degrading "
+            "request to one-token constrained decoding and replaying",
+            slot_idx,
+        )
+        slot = self.slots[slot_idx]
+        slot.active = False
+        slot.request = None
+        self.jump_ok[slot_idx] = False
+        self.temps[slot_idx] = 0.0
+        self.adapter_ids[slot_idx] = 0
+        self.gstates[slot_idx] = 0
+        self._slot_last_emit[slot_idx] = None
+        if self._paged:
+            self.pages.free_slot(slot_idx)
+            self._tables_dirty = True
+        # This runs on the batcher's executor; the replay requeue
+        # touches loop-owned state (pending queue + wake event), so hop
+        # through the loop like every other executor→loop edge.
+        self._loop_ref.call_soon_threadsafe(self._replay_or_fail, request)
 
     def _emit_chunk(self, slot_idx: int, tokens) -> None:
         """Deliver a tick's tokens for one slot: truncate at EOS or the
@@ -3849,6 +4299,7 @@ class ContinuousBatcher:
             self.temps[slot_idx] = 0.0
             self.adapter_ids[slot_idx] = 0
             self.gstates[slot_idx] = 0
+            self.jump_ok[slot_idx] = False
             if self._paged:
                 # Release the slot's page references (indexed pages
                 # stay resident as evictable reuse cache) and unmap the

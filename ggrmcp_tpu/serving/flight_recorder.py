@@ -103,6 +103,12 @@ class TickRecord:
     # plain ticks). Completed at collect, like finished/duration_ms.
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # Jump-ahead tick (grammar.jump_max > 0): forced tokens emitted by
+    # multi-token advances on THIS tick and runs advanced (0/0 on
+    # plain/spec ticks) — the per-tick jump trace beside the spec
+    # acceptance one. Completed at collect, like finished/duration_ms.
+    jump_tokens: int = 0
+    jump_runs: int = 0
     # Paged KV arena occupancy at dispatch (batching.paged_kv=on; 0
     # off): resident pages — live + reuse-cached — so a tick window
     # shows page pressure next to its admissions/finishes.
@@ -144,6 +150,8 @@ class TickRecord:
             "source": self.source,
             "specDrafted": self.spec_drafted,
             "specAccepted": self.spec_accepted,
+            "jumpTokens": self.jump_tokens,
+            "jumpRuns": self.jump_runs,
             "kvPagesInUse": self.kv_pages_in_use,
             "phaseAdmitMs": round(self.phase_admit_ms, 3),
             "phaseSyncMs": round(self.phase_sync_ms, 3),
@@ -294,15 +302,17 @@ class FlightRecorder:
         finished: int,
         spec_drafted: int = 0,
         spec_accepted: int = 0,
+        jump_tokens: int = 0,
+        jump_runs: int = 0,
     ) -> None:
         """Complete a tick at its token collect: stamp the tick's
         duration (admit seed + the contiguous admit-to-host span;
         includes the deliberate one-tick lag under pipelining), settle
         the phase attribution (the final `host` mark covers emission
         and finish bookkeeping — the caller marked sync/dispatch/wait),
-        how many requests finished on it, and — on speculative ticks —
-        the round's draft/accept counts (the per-tick acceptance
-        trace)."""
+        how many requests finished on it, and — on speculative/jump
+        ticks — the round's draft/accept or forced-run counts (the
+        per-tick acceptance and jump traces)."""
         if rec is None:
             return
         if rec.phases is not None:
@@ -322,6 +332,8 @@ class FlightRecorder:
         rec.finished = finished
         rec.spec_drafted = spec_drafted
         rec.spec_accepted = spec_accepted
+        rec.jump_tokens = jump_tokens
+        rec.jump_runs = jump_runs
         with self._lock:
             self._hists["tick_duration_ms"].observe(rec.duration_ms)
             for phase in PHASE_NAMES:

@@ -391,7 +391,15 @@ class PageAllocator:
         construction — the rule the old `share=False` full-recompute
         gate enforced by never sharing at all. `share=False` still
         allocates fully exclusive and consults nothing (transfer/test
-        paths that must bypass the index)."""
+        paths that must bypass the index). Under jump-ahead constrained
+        decoding (grammar.jump_max > 0) the batcher folds the jump
+        window into a GRAMMAR-CARRYING request's need_len at admission
+        — a jump tick writes up to 1 + jump_max KV positions at once,
+        so a constrained row's block table already covers the deepest
+        multi-token advance and the paged walk never extends mid-run.
+        Unconstrained rows keep the plain reserve; their surplus window
+        positions in a jump tick scatter to the sentinel and drop
+        (models/llama.py)."""
         self.free_slot(slot)  # defensive: admit implies a parked row
         p = self.page_size
         w_need = -(-need_len // p)

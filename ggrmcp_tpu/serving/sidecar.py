@@ -755,14 +755,21 @@ class Sidecar:
         target = request.kv_transfer_target
         # Clamp with the REQUEST's max_new (fit_request keeps the
         # tail): the exported chain must be the one the decode
-        # replica's identically clamped admission will look up.
+        # replica's identically clamped admission will look up. The
+        # constraint flag rides along for the same reason — a grammar
+        # widens the decode replica's jump-window reserve, so a
+        # constrained near-limit prompt clamps shorter there.
         max_new = min(
             request.max_new_tokens or 64,
             self.serving.batching.max_decode_steps,
         )
+        spec = request.constraint
+        constrained = bool(
+            spec.json_schema or spec.tool_output_schema_ref
+        )
         clamp = getattr(self.batcher, "clamp_prompt", None)
         if clamp is not None:
-            prompt = clamp(prompt, max_new)
+            prompt = clamp(prompt, max_new, constrained=constrained)
         try:
             # Chaos hook (utils/failpoints.py kv_transfer_fail): an
             # injected fault IS a failed transfer — same typed path.

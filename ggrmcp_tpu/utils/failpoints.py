@@ -26,6 +26,14 @@ Hook sites (the names the serving plane evaluates):
   page_exhausted same site, per paged-KV row — forces the page
                  allocator's exhaustion path (typed RESOURCE_EXHAUSTED
                  shed; batching.paged_kv=on only)
+  grammar_jump_fail ContinuousBatcher._jump_validate — collect-side
+                 validation of a jump-ahead forced run: the run is
+                 refused as if the device landing state were bad, the
+                 slot degrades typed to plain one-token constrained
+                 decoding (grammar_jump_fallbacks counter; replay
+                 re-prefills the emitted prefix) and the greedy output
+                 stays schema-valid and bit-identical
+                 (tests/test_grammar_jump.py)
   adapter_load_fail AdapterArena._load — before a registered LoRA
                  adapter's factors are read + installed H2D: the load
                  "fails" typed (AdapterLoadError → gRPC ABORTED at the

@@ -257,6 +257,45 @@ class TestAllocInJit:
         assert rule_ids(report) == ["alloc-in-jit"]
         assert "HOST state" in report.findings[0].message
 
+    def test_fires_in_jump_tick_through_core_helper(self, tmp_path):
+        # ISSUE 16's multi-token advance is a root too (`_tick_jump_impl`
+        # matches the tick-body pattern): a forced-run window conjured
+        # fresh inside the advance — instead of concatenated from the
+        # traced run-table gathers — fires through the same
+        # intra-module reachability as any other tick helper.
+        report = lint(
+            tmp_path, "ggrmcp_tpu/serving/batching.py", """
+            import jax.numpy as jnp
+
+            class Batcher:
+                def _tick_jump_impl(self, params, tokens, cache):
+                    return self._jump_core(tokens, cache)
+
+                def _jump_core(self, tokens, cache):
+                    window = jnp.zeros((4, 9), jnp.int32)
+                    return window.at[:, 0].set(tokens), cache
+            """,
+        )
+        assert rule_ids(report) == ["alloc-in-jit"]
+        assert "_jump_core" in report.findings[0].message
+
+    def test_jump_window_from_traced_gathers_clean(self, tmp_path):
+        # The shipped shape: the window is concatenate/pad over traced
+        # inputs and the donated cache is written through — no fresh
+        # buffer, nothing to flag.
+        report = lint(
+            tmp_path, "ggrmcp_tpu/serving/batching.py", """
+            import jax.numpy as jnp
+
+            class Batcher:
+                def _tick_jump_impl(self, params, tokens, cache, run):
+                    window = jnp.concatenate([tokens[:, None], run], axis=1)
+                    emit = jnp.pad(run, ((0, 0), (0, 1)))
+                    return window, emit, cache._replace(length=cache.length)
+            """,
+        )
+        assert report.clean
+
     def test_admission_path_exempt(self, tmp_path):
         # Allocation at ADMISSION is the invariant's sanctioned side.
         report = lint(
