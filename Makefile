@@ -8,7 +8,8 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: proto proto-check descriptors test test-all test-fast test-chaos \
   test-obs test-grammar test-grammar-jump test-spec-batch test-paged \
   test-tp test-analysis \
-  test-disagg test-fleet test-mem test-kvtier test-lora-arena bench-cpu \
+  test-disagg test-fleet test-mem test-kvtier test-lora-arena test-slo \
+  bench-cpu \
   smoke e2e lint graftlint ci-local preflight clean
 
 # Regenerate pb2 modules from protos/ (committed; rerun after editing).
@@ -174,6 +175,15 @@ test-kvtier:
 # too; this target is the fast inner loop for multi-tenant LoRA work.
 test-lora-arena:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m lora_arena
+
+# Tenant & SLO accounting plane alone (CPU mesh): goodput-partition
+# closure across plain/paged/tiered/spec/grammar configs and under
+# chaos, burn-rate windows, the bounded tenant table under churn,
+# obs-off zero-work, /debug/slo + ?tenant= parity on both http impls,
+# and the class-labeled /metrics families. Tier-1 runs these too; this
+# target is the fast inner loop for serving/slo.py work.
+test-slo:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m slo
 
 # ruff if present (baked CI image installs it; the TPU image may not).
 lint:

@@ -116,6 +116,19 @@ Environment knobs:
                          GGRMCP_BENCH_LORA_ADAPTERS (8),
                          GGRMCP_BENCH_LORA_SESSIONS (2 per adapter),
                          GGRMCP_BENCH_LORA_CALLS (2 per session).
+  GGRMCP_BENCH_TENANTS   mixed-tenant SLO phase ("on" by default
+                         off-TPU, "off" skips): N tenants with an
+                         80/20 call skew across two QoS classes
+                         (interactive + batch) in ONE continuous
+                         batch — per-class TTFT/e2e p99, the goodput
+                         partition (met/violated/unevaluated, closure
+                         asserted), and the per-tenant weighted-token
+                         attribution spread from the bounded table
+                         (tenant_slo_* extras + the full per-tenant
+                         table in bench_artifacts/tenant_slo.json;
+                         docs/observability.md "SLO plane"). Knobs:
+                         GGRMCP_BENCH_TENANT_COUNT (10),
+                         GGRMCP_BENCH_TENANT_CALLS (4 per tenant).
   GGRMCP_BENCH_REPLICAS=N  N-replica routing phase (standalone mode,
                          like PROXY_ONLY): spins N paged-KV sidecar
                          replica PROCESSES behind one gateway and
@@ -1435,6 +1448,21 @@ async def _run_bench() -> dict:
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: lora phase failed: {exc!r}", file=sys.stderr)
 
+    # Mixed-tenant SLO accounting (GGRMCP_BENCH_TENANTS,
+    # docs/observability.md "SLO plane"): same isolation rationale —
+    # runs after the serving stack is down, on its own batcher.
+    tenants = {}
+    want_tenants = os.environ.get("GGRMCP_BENCH_TENANTS")
+    if want_tenants == "on" or (
+        want_tenants is None and not headline_only and not on_tpu
+    ):
+        try:
+            tenants = await _tenants_bench(
+                model, max_new, tick_steps, quantize, kv_dtype, synth,
+            )
+        except Exception as exc:  # secondary phase must not sink the run
+            print(f"bench: tenants phase failed: {exc!r}", file=sys.stderr)
+
     # Tensor-parallel serving A/B (GGRMCP_BENCH_TP,
     # docs/tensor_parallel_serving.md): same isolation rationale —
     # runs after the serving stack is down, on its own engines.
@@ -1460,7 +1488,7 @@ async def _run_bench() -> dict:
     return {
         **headline, **hbm, **obs_export, **prefix, **longp, **mixed,
         **grammar, **ticktime, **specbatch, **jump, **paged, **kvtier,
-        **lora,
+        **lora, **tenants,
         **tp, **proxy,
     }
 
@@ -1649,6 +1677,149 @@ async def _lora_bench(
             json.dump(out, fh, indent=1, sort_keys=True)
     except OSError as exc:  # artifact write must not sink the phase
         print(f"bench: lora artifact write failed: {exc}", file=sys.stderr)
+    return out
+
+
+async def _tenants_bench(
+    model: str, max_new: int, tick_steps, quantize: str, kv_dtype: str,
+    synth: bool,
+) -> dict:
+    """Mixed-tenant SLO accounting phase (serving/slo.py,
+    docs/observability.md "SLO plane"): N tenants with an 80/20 call
+    skew — the top fifth of tenants issue 80% of the calls — split
+    across two QoS classes (interactive: tight targets most calls will
+    miss on a CPU stand-in; batch: loose targets they meet), all in
+    ONE continuous batch. Exports per-class client-side TTFT/e2e p99,
+    the backend's goodput partition per class (met/violated/
+    unevaluated — closure against total asserted HERE, under real
+    concurrency, not just in unit tests), the per-tenant weighted-token
+    attribution spread, and the table-bound counters. The full
+    per-tenant table rides bench_artifacts/tenant_slo.json."""
+    import asyncio as _asyncio
+
+    from ggrmcp_tpu.core.config import (
+        BatchingConfig, MeshConfig, ObservabilityConfig, ServingConfig,
+        SloConfig,
+    )
+    from ggrmcp_tpu.models import get_model
+    from ggrmcp_tpu.ops.sampling import SamplingConfig
+    from ggrmcp_tpu.serving.batching import ContinuousBatcher
+    from ggrmcp_tpu.serving.engine import GenerationEngine
+    from ggrmcp_tpu.utils.stats import pct
+
+    n_tenants = int(os.environ.get("GGRMCP_BENCH_TENANT_COUNT", "10"))
+    calls_per = int(os.environ.get("GGRMCP_BENCH_TENANT_CALLS", "4"))
+    budget = max(8, max_new)
+    _, mcfg = get_model(model)
+    engine = GenerationEngine(mcfg, ServingConfig(
+        model=model, quantize=quantize, kv_cache_dtype=kv_dtype,
+        synthetic_weights=synth, mesh=MeshConfig(),
+        observability=ObservabilityConfig(enabled=True),
+        # Targets bracketing a CPU stand-in's latency: interactive is
+        # tight enough that misses occur (the violated/burn surfaces
+        # get real data), batch loose enough that it meets (goodput
+        # shows a real partition, not one degenerate bucket).
+        slo=SloConfig(classes={
+            "interactive": {"ttft_p99_ms": 30.0, "tpot_p99_ms": 20.0},
+            "batch": {"ttft_p99_ms": 60000.0, "tpot_p99_ms": 10000.0},
+        }),
+    ))
+    batcher = ContinuousBatcher(engine, BatchingConfig(
+        max_batch_size=8, kv_cache_max_seq=512,
+        decode_steps_per_tick=tick_steps,
+    ))
+    loop = _asyncio.get_running_loop()
+    await loop.run_in_executor(None, batcher.warmup)
+    batcher.start()
+    greedy = SamplingConfig(temperature=0.0)
+    # 80/20 skew: the first ceil(N/5) tenants carry 4 calls for every
+    # 1 the tail carries.
+    heavy = max(1, n_tenants // 5)
+    plan: list[tuple[str, str]] = []
+    for i in range(n_tenants):
+        weight = 4 if i < heavy else 1
+        qos = "interactive" if i % 2 == 0 else "batch"
+        plan.extend(
+            (f"tenant{i:03d}", qos) for _ in range(calls_per * weight)
+        )
+    lat: dict[str, list[tuple[float, float]]] = {}
+
+    async def run_call(k: int, tenant: str, qos: str):
+        prompt = [3 + (hash((tenant, k, i)) % 200) for i in range(4)]
+        t0 = time.perf_counter()
+        first = None
+        async for ids, _reason in batcher.submit(
+            prompt, budget, greedy, seed=k,
+            tenant=tenant, qos_class=qos,
+        ):
+            if first is None and ids:
+                first = (time.perf_counter() - t0) * 1000.0
+        lat.setdefault(qos, []).append(
+            (first or 0.0, (time.perf_counter() - t0) * 1000.0)
+        )
+
+    out: dict = {
+        "tenant_slo_tenants": n_tenants,
+        "tenant_slo_calls": len(plan),
+    }
+    t0 = time.perf_counter()
+    try:
+        await _asyncio.gather(*(
+            run_call(k, tenant, qos)
+            for k, (tenant, qos) in enumerate(plan)
+        ))
+        elapsed = time.perf_counter() - t0
+        stats = batcher.stats()
+    finally:
+        await batcher.stop()
+    out["tenant_slo_calls_per_sec"] = round(len(plan) / elapsed, 2)
+    for qos, pairs in sorted(lat.items()):
+        out[f"tenant_slo_{qos}_ttft_p99_ms"] = round(
+            pct([p[0] for p in pairs], 0.99), 2
+        )
+        out[f"tenant_slo_{qos}_e2e_p99_ms"] = round(
+            pct([p[1] for p in pairs], 0.99), 2
+        )
+    goodput = {}
+    for cls in stats.get("slo_classes", []):
+        total = cls["total_requests"]
+        parts = (cls["met"], cls["violated"], cls["unevaluated"])
+        assert sum(parts) == total, (
+            f"SLO closure broken under load: {parts} != {total}"
+        )
+        goodput[cls["name"]] = {
+            "met": parts[0], "violated": parts[1],
+            "unevaluated": parts[2],
+            "goodput": round(parts[0] / max(total, 1), 4),
+        }
+    out["tenant_slo_goodput"] = goodput
+    rows = stats.get("tenants", [])
+    weighted = [r["weighted_tokens"] for r in rows if r["tenant"]]
+    if weighted:
+        out["tenant_slo_weighted_tokens_top"] = round(max(weighted), 1)
+        out["tenant_slo_weighted_tokens_bottom"] = round(
+            min(weighted), 1
+        )
+    out["tenant_slo_tracked"] = stats.get("slo_tenants_tracked", 0)
+    out["tenant_slo_evictions"] = stats.get("slo_tenant_evictions", 0)
+    # Full table (per-tenant rows don't fit the headline artifact).
+    try:
+        art_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_artifacts"
+        )
+        os.makedirs(art_dir, exist_ok=True)
+        with open(
+            os.path.join(art_dir, "tenant_slo.json"), "w",
+            encoding="utf-8",
+        ) as fh:
+            json.dump(
+                {**out, "tenant_table": rows,
+                 "slo_classes": stats.get("slo_classes", [])},
+                fh, indent=1, sort_keys=True,
+            )
+    except OSError as exc:  # artifact write must not sink the phase
+        print(f"bench: tenants artifact write failed: {exc}",
+              file=sys.stderr)
     return out
 
 

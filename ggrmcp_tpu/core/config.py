@@ -123,6 +123,8 @@ class HeaderForwardingConfig:
             "x-user-id",
             "x-session-id",
             "x-adapter-id",
+            "x-tenant-id",
+            "x-qos-class",
             "x-api-key",
             "user-agent",
             "accept-language",
@@ -508,6 +510,59 @@ class ObservabilityConfig:
     )
 
 
+# Default QoS classes (slo.classes): per-class p99 latency objectives
+# in milliseconds. TTFT = time to first token, TPOT = time per output
+# token (decode interval). The three-tier shape follows DistServe's
+# goodput framing (Zhong et al., OSDI'24): a request counts toward
+# goodput only when it meets BOTH its class targets.
+DEFAULT_SLO_CLASSES = {
+    "interactive": {"ttft_p99_ms": 500.0, "tpot_p99_ms": 100.0},
+    "batch": {"ttft_p99_ms": 5000.0, "tpot_p99_ms": 500.0},
+    "background": {"ttft_p99_ms": 30000.0, "tpot_p99_ms": 2000.0},
+}
+
+
+@dataclass
+class SloConfig:
+    """Tenant & SLO accounting plane (serving/slo.py,
+    docs/observability.md 'SLO accounting'): per-class goodput
+    (met/violated/unevaluated partition the total exactly), per-class
+    TTFT/TPOT/e2e histograms, SRE multi-window burn rate, and
+    cardinality-bounded per-tenant VTC token attribution. Pure
+    measurement — the ROADMAP item 2 scheduler consumes these numbers,
+    this layer never influences placement. Requires
+    observability.enabled (the terminal-chunk hook lives in the flight
+    recorder path); disabled, every hook is one attribute check."""
+
+    enabled: bool = True
+    # Class a request lands in when it carries no (valid) x-qos-class.
+    default_class: str = "interactive"
+    # QoS class name → {"ttft_p99_ms": float, "tpot_p99_ms": float}.
+    # Class names become Prometheus label values — keep them few and
+    # stable (the per-tenant axis is the bounded one, not this).
+    classes: dict = field(
+        default_factory=lambda: {
+            k: dict(v) for k, v in DEFAULT_SLO_CLASSES.items()
+        }
+    )
+    # SRE multi-window burn-rate windows (seconds): burn = violation
+    # rate over the window / error budget (0.01 for a p99 objective).
+    # Fast window pages, slow window confirms (Google SRE workbook
+    # ch. 5 shape).
+    burn_windows_s: list = field(default_factory=lambda: [300.0, 3600.0])
+    # Per-tenant table cardinality bound: at most this many distinct
+    # tenants tracked per batcher; the least-recently-active tenant is
+    # folded into the explicit "~overflow" bucket when a new one needs
+    # the slot, so counters conserve while label growth stays bounded.
+    tenant_top_k: int = 64
+    # VTC weights (S-LoRA/VTC fairness accounting): weighted tokens =
+    # vtc_prompt_weight * prompt_tokens + vtc_decode_weight *
+    # decode_tokens. Decode tokens cost more than prefill tokens per
+    # unit of service time, so they weigh heavier by default.
+    vtc_prompt_weight: float = 1.0
+    vtc_decode_weight: float = 2.0
+
+
 @dataclass
 class GrammarConfig:
     """Schema-constrained decoding (ggrmcp_tpu/grammar): compile MCP
@@ -816,6 +871,9 @@ class ServingConfig:
     )
     # Schema-constrained decoding (DFA logit masking) — GrammarConfig.
     grammar: "GrammarConfig" = field(default_factory=lambda: GrammarConfig())
+    # Tenant & SLO accounting plane (per-class goodput/burn, per-tenant
+    # VTC token attribution) — SloConfig.
+    slo: "SloConfig" = field(default_factory=lambda: SloConfig())
 
 
 @dataclass
@@ -1028,6 +1086,62 @@ class Config:
             raise ValueError(
                 "grammar.jump_max must be in [0, 16] (0 disables "
                 "jump-ahead; 16 is the compiler's forced-run cap)"
+            )
+        slo = self.serving.slo
+        if not isinstance(slo.classes, dict) or not slo.classes:
+            raise ValueError(
+                "serving.slo.classes must be a non-empty dict of "
+                "class name -> {ttft_p99_ms, tpot_p99_ms}"
+            )
+        for cname, targets in slo.classes.items():
+            if not isinstance(cname, str) or not cname:
+                raise ValueError(
+                    "serving.slo.classes keys must be non-empty class names"
+                )
+            if not isinstance(targets, dict):
+                raise ValueError(
+                    f"serving.slo.classes[{cname!r}] must be a dict "
+                    "with ttft_p99_ms/tpot_p99_ms"
+                )
+            unknown = set(targets) - {"ttft_p99_ms", "tpot_p99_ms"}
+            if unknown:
+                raise ValueError(
+                    f"serving.slo.classes[{cname!r}]: unknown keys "
+                    f"{sorted(unknown)}; supported: ttft_p99_ms, "
+                    "tpot_p99_ms"
+                )
+            for key in ("ttft_p99_ms", "tpot_p99_ms"):
+                try:
+                    val = float(targets.get(key, 0))
+                except (TypeError, ValueError):
+                    val = -1.0
+                if val <= 0:
+                    raise ValueError(
+                        f"serving.slo.classes[{cname!r}].{key} must be "
+                        "a positive number of milliseconds"
+                    )
+        if slo.default_class not in slo.classes:
+            raise ValueError(
+                f"serving.slo.default_class {slo.default_class!r} is not "
+                f"in serving.slo.classes {sorted(slo.classes)}"
+            )
+        try:
+            windows = [float(w) for w in slo.burn_windows_s]
+        except (TypeError, ValueError):
+            raise ValueError("serving.slo.burn_windows_s must be numbers")
+        if not windows or any(w <= 0 for w in windows) or windows != sorted(
+            set(windows)
+        ):
+            raise ValueError(
+                "serving.slo.burn_windows_s must be strictly ascending "
+                "positive window lengths (seconds)"
+            )
+        if slo.tenant_top_k < 1:
+            raise ValueError("serving.slo.tenant_top_k must be >= 1")
+        if slo.vtc_prompt_weight < 0 or slo.vtc_decode_weight < 0:
+            raise ValueError(
+                "serving.slo.vtc_prompt_weight/vtc_decode_weight must "
+                "be >= 0"
             )
         so = self.gateway.structured_output
         if not isinstance(so, dict) or not all(

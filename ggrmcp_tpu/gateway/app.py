@@ -98,6 +98,7 @@ class Gateway:
             "/debug/timeline", self.handler.handle_debug_timeline
         )
         app.router.add_get("/debug/memory", self.handler.handle_debug_memory)
+        app.router.add_get("/debug/slo", self.handler.handle_debug_slo)
         app.router.add_post(
             "/debug/profile", self.handler.handle_debug_profile
         )

@@ -650,8 +650,12 @@ class FastLaneServer:
                 query.get("trace_id", [""])[0],
                 query.get("n", ["128"])[0],
                 query.get("source", [""])[0],
+                query.get("tenant", [""])[0],
             )
             self._write_json(conn, headers, 200, body)
+            return 200
+        if path == "/debug/slo":
+            self._write_json(conn, headers, 200, await h.debug_slo_body())
             return 200
         if path == "/debug/timeline":
             query = parse_qs(urlsplit(target).query)

@@ -430,6 +430,41 @@ class MCPHandler:
             return arguments  # binding on a non-generate tool: skip
         return {**arguments, "adapter": name}
 
+    def _apply_tenant_binding(
+        self, tool_name: str, arguments: Any, session: SessionContext
+    ) -> Any:
+        """SLO-plane identity (serving/slo.py, docs/observability.md):
+        inject the session's forwarded `x-tenant-id` / `x-qos-class`
+        headers as the `tenantId` / `qosClass` request fields so the
+        backend attributes tokens and classifies latency without
+        re-parsing metadata. Explicit arguments the caller passed win;
+        only tools whose input message carries the fields (the TPU
+        Generate surface) are eligible — anything else passes through
+        untouched. The sidecar applies the same precedence a second
+        time from raw metadata, so non-gateway gRPC callers get
+        identical attribution."""
+        if not isinstance(arguments, dict):
+            return arguments
+        wanted = {"x-tenant-id": "tenantId", "x-qos-class": "qosClass"}
+        inject: dict[str, str] = {}
+        for key, value in session.headers.items():
+            arg = wanted.get(key.lower())
+            if arg and value and not arguments.get(arg):
+                inject[arg] = (
+                    value[0] if isinstance(value, list) else value
+                )
+        if not inject:
+            return arguments
+        try:
+            method = self.discoverer.get_method_by_tool(tool_name)
+        except ToolNotFoundError:
+            return arguments  # invoke will surface the real error
+        desc = method.input_descriptor
+        if desc is None or "tenant_id" not in desc.fields_by_name \
+                or "qos_class" not in desc.fields_by_name:
+            return arguments  # binding on a non-generate tool: skip
+        return {**arguments, **inject}
+
     async def _handle_tools_call(
         self,
         session: SessionContext,
@@ -438,6 +473,7 @@ class MCPHandler:
         tool_name, arguments = self.validator.validate_tool_call_params(params)
         arguments = self._apply_structured_output(tool_name, arguments)
         arguments = self._apply_adapter_binding(tool_name, arguments, session)
+        arguments = self._apply_tenant_binding(tool_name, arguments, session)
         headers = self._metadata_with_trace(session)
         start = time.perf_counter()
         try:
@@ -541,6 +577,7 @@ class MCPHandler:
         tool_name, arguments = self.validator.validate_tool_call_params(params)
         arguments = self._apply_structured_output(tool_name, arguments)
         arguments = self._apply_adapter_binding(tool_name, arguments, session)
+        arguments = self._apply_tenant_binding(tool_name, arguments, session)
         headers = self._metadata_with_trace(session)
         await sse.start(session.id, trace_id)
         start = time.perf_counter()
@@ -798,7 +835,8 @@ class MCPHandler:
         )
 
     async def debug_flight_body(
-        self, kind: str, trace_id: str, n_raw: str, source: str = ""
+        self, kind: str, trace_id: str, n_raw: str, source: str = "",
+        tenant: str = "",
     ) -> dict[str, Any]:
         """GET /debug/ticks | /debug/requests core: the backends'
         flight-recorder rings (DebugService.GetFlightRecord fan-out),
@@ -809,7 +847,10 @@ class MCPHandler:
         framework-free, shared by the aiohttp handler and the fast
         lane. The ticks body carries a `fields` help table
         (metrics.tick_field_help — the proto-drift-enforced descriptor
-        set) so the record keys are self-describing."""
+        set) so the record keys are self-describing. `tenant` filters
+        request records to one tenant's lifecycle (server-side, like
+        trace_id — the SLO plane's drill-down from an aggregate
+        /debug/slo row to the individual requests behind it)."""
         try:
             n = int(n_raw)
         except ValueError:
@@ -819,6 +860,7 @@ class MCPHandler:
             trace_id=trace_id,
             max_ticks=n if kind == "ticks" else 1,
             max_requests=n if kind == "requests" else 1,
+            tenant=tenant if kind == "requests" else "",
         )
         backends = []
         for entry in entries:
@@ -846,6 +888,8 @@ class MCPHandler:
             body["traceId"] = trace_id
         if source:
             body["source"] = source
+        if tenant and kind == "requests":
+            body["tenant"] = tenant
         if kind == "ticks":
             body["fields"] = tick_field_help()
         else:
@@ -875,7 +919,51 @@ class MCPHandler:
             request.query.get("trace_id", ""),
             request.query.get("n", "128"),
             request.query.get("source", ""),
+            request.query.get("tenant", ""),
         ))
+
+    async def debug_slo_body(self) -> dict[str, Any]:
+        """GET /debug/slo core: the SLO accounting plane's full
+        surface, per backend (serving/slo.py) — the per-class goodput
+        partition, latency histograms and burn rates that /metrics
+        exports, PLUS the per-tenant attribution table that /metrics
+        deliberately does NOT (tenant is an unbounded label; here it is
+        a bounded JSON list with an explicit ~overflow row). Fans out
+        the same ServingStats RPC as /stats and filters it to the SLO
+        fragments; framework-free, shared by both HTTP impls."""
+        entries = await self.discoverer.get_backend_serving_stats()
+        backends = []
+        for entry in entries:
+            if "error" in entry:
+                backends.append(
+                    {"target": entry["target"], "error": entry["error"]}
+                )
+                continue
+            backends.append({
+                "target": entry["target"],
+                # protojson omits empty repeateds and zero scalars:
+                # restore them so the body shape is stable whether or
+                # not traffic (or the SLO plane itself) has happened.
+                "classes": entry.get("sloClasses", []),
+                "tenants": entry.get("tenants", []),
+                "metTotal": int(float(entry.get("sloMetTotal", 0))),
+                "violatedTotal": int(
+                    float(entry.get("sloViolatedTotal", 0))
+                ),
+                "unevaluatedTotal": int(
+                    float(entry.get("sloUnevaluatedTotal", 0))
+                ),
+                "tenantsTracked": int(
+                    float(entry.get("sloTenantsTracked", 0))
+                ),
+                "tenantEvictions": int(
+                    float(entry.get("sloTenantEvictions", 0))
+                ),
+            })
+        return {"backends": backends}
+
+    async def handle_debug_slo(self, request: web.Request) -> web.Response:
+        return web.json_response(await self.debug_slo_body())
 
     async def timeline_body(self, n_raw: str) -> dict[str, Any]:
         """GET /debug/timeline core: the unified Chrome trace-event

@@ -223,6 +223,26 @@ _SERVING_HELP = {
     "lora_shed":
         "adapter acquisitions shed typed with every row pinned "
         "(RESOURCE_EXHAUSTED -> HTTP 429)",
+    # SLO accounting plane (serving/slo.py, docs/observability.md):
+    # cross-class totals; the per-class partition and burn rates export
+    # through the class-labeled families (_SloCollector), the
+    # per-tenant table through /debug/slo only (unbounded label
+    # cardinality has no place in Prometheus).
+    "slo_met_total":
+        "requests that finished normally within BOTH their class's "
+        "TTFT and TPOT targets (goodput numerator, all classes)",
+    "slo_violated_total":
+        "requests that missed a latency target or finished abnormally "
+        "after admission (all classes)",
+    "slo_unevaluated_total":
+        "requests shed before admission — counted, never silently "
+        "dropped (met+violated+unevaluated == total, all classes)",
+    "slo_tenants_tracked":
+        "distinct tenants currently holding a row in the bounded "
+        "attribution table (excl. the ~overflow bucket)",
+    "slo_tenant_evictions":
+        "tenant rows LRU-folded into the ~overflow bucket under "
+        "cardinality churn (counters conserve)",
 }
 
 _SERVING_HIST_HELP = {
@@ -573,6 +593,147 @@ class _ServingMemoryCollector:
         self.snap.pop(target, None)
 
 
+# The three per-class histogram metrics and their SloClassStats proto
+# field prefixes — one {target, class, metric}-labeled family instead
+# of three per-class name families, so a dashboard overlays a class's
+# TTFT/TPOT/e2e on one chart and PromQL windows quantiles per class
+# with `sum by (class, metric, le)`.
+_SLO_METRICS = ("ttft", "tpot", "e2e")
+
+
+class _SloCollector:
+    """Renders the backends' per-class SLO snapshot (ServingStats
+    `slo_classes` — serving/slo.py) as class-labeled families:
+
+    - gateway_backend_class_latency_ms{target, class, metric} — real
+      histograms (metric = ttft|tpot|e2e), bucketed on the backend
+      with the flight recorder's shared bounds
+    - gateway_backend_slo_requests{target, class, outcome} — the
+      goodput partition (outcome = met|violated|unevaluated; the three
+      sum to the class's total requests EXACTLY)
+    - gateway_backend_slo_burn_rate{target, class, window} — SRE
+      multi-window error-budget burn (window = seconds, e.g. "300")
+    - gateway_backend_slo_target_ms{target, class, metric} — the
+      configured p99 targets (metric = ttft|tpot), exported so alert
+      rules and dashboards read objectives from the SAME scrape as the
+      observations
+
+    A custom collector because the class set is a label dimension and
+    the histograms arrive pre-bucketed. The per-tenant table is
+    deliberately NOT exported here — tenant is an unbounded label; it
+    lives on /debug/slo."""
+
+    def __init__(self) -> None:
+        # target -> list of parsed class dicts
+        self.snap: dict[str, list[dict]] = {}
+
+    def collect(self):
+        hist = HistogramMetricFamily(
+            "gateway_backend_class_latency_ms",
+            "Backend SLO plane: per-QoS-class latency (ms) by metric "
+            "(ttft|tpot|e2e) — serving/slo.py terminal-chunk "
+            "classification",
+            labels=["target", "class", "metric"],
+        )
+        requests = GaugeMetricFamily(
+            "gateway_backend_slo_requests",
+            "Backend SLO plane: per-class goodput partition "
+            "(outcome = met|violated|unevaluated; outcomes sum to the "
+            "class total exactly)",
+            labels=["target", "class", "outcome"],
+        )
+        burn = GaugeMetricFamily(
+            "gateway_backend_slo_burn_rate",
+            "Backend SLO plane: error-budget burn rate over the "
+            "trailing window (1.0 = burning exactly the budget; "
+            "window label is seconds)",
+            labels=["target", "class", "window"],
+        )
+        target_ms = GaugeMetricFamily(
+            "gateway_backend_slo_target_ms",
+            "Backend SLO plane: configured per-class p99 latency "
+            "objectives (metric = ttft|tpot)",
+            labels=["target", "class", "metric"],
+        )
+        for target in sorted(self.snap):
+            for cls in self.snap[target]:
+                name = cls["name"]
+                for metric in _SLO_METRICS:
+                    bounds, counts, total_sum = cls["hist"][metric]
+                    hist.add_metric(
+                        [target, name, metric],
+                        _ServingHistogramCollector._le_buckets(
+                            bounds, counts
+                        ),
+                        total_sum,
+                    )
+                for outcome in ("met", "violated", "unevaluated"):
+                    requests.add_metric(
+                        [target, name, outcome], cls[outcome]
+                    )
+                for window_s, rate in cls["burn"]:
+                    burn.add_metric(
+                        [target, name, f"{window_s:g}"], rate
+                    )
+                for metric, value in (
+                    ("ttft", cls["ttft_target_ms"]),
+                    ("tpot", cls["tpot_target_ms"]),
+                ):
+                    target_ms.add_metric([target, name, metric], value)
+        yield hist
+        yield requests
+        yield burn
+        yield target_ms
+
+    def update(self, target: str, per_backend_entry: dict) -> None:
+        """Parse one protojson ServingStats entry's sloClasses list
+        (camelCase keys; int64 counters arrive as strings). Entries
+        with no SLO data (old backend or observability off) clear the
+        target so nothing stale exports."""
+        classes = per_backend_entry.get("sloClasses") or []
+        bounds = tuple(
+            float(b)
+            for b in per_backend_entry.get("latencyBucketBoundsMs", [])
+        )
+        parsed: list[dict] = []
+        for cls in classes:
+            per_metric: dict[str, tuple] = {}
+            for metric in _SLO_METRICS:
+                counts = [
+                    int(float(c))
+                    for c in cls.get(f"{metric}MsBucket", [])
+                ]
+                if len(counts) != len(bounds) + 1:
+                    # Zero observations (protojson omits empty repeated
+                    # fields) or torn bounds: well-formed all-zero.
+                    counts = [0] * (len(bounds) + 1)
+                per_metric[metric] = (
+                    bounds,
+                    counts,
+                    float(cls.get(f"{metric}MsSum", 0.0)),
+                )
+            parsed.append({
+                "name": str(cls.get("name", "")),
+                "hist": per_metric,
+                "met": float(cls.get("met", 0)),
+                "violated": float(cls.get("violated", 0)),
+                "unevaluated": float(cls.get("unevaluated", 0)),
+                "burn": list(zip(
+                    (float(w) for w in cls.get("burnWindowS", [])),
+                    (float(r) for r in cls.get("burnRate", [])),
+                )),
+                "ttft_target_ms": float(cls.get("ttftP99TargetMs", 0)),
+                "tpot_target_ms": float(cls.get("tpotP99TargetMs", 0)),
+            })
+        if parsed:
+            self.snap[target] = parsed
+        else:
+            self.snap.pop(target, None)
+
+    def remove(self, target: str) -> None:
+        self.snap.pop(target, None)
+
+
 class GatewayMetrics:
     """All gateway-side instruments, on a private registry."""
 
@@ -666,6 +827,11 @@ class GatewayMetrics:
         # bytes, the HBM partition beside the time partition above.
         self.serving_memory = _ServingMemoryCollector()
         self.registry.register(self.serving_memory)
+        # SLO plane: class-labeled latency/goodput/burn families
+        # (serving/slo.py per-class accounts, re-exposed like the
+        # histograms above — authoritative counts live on the backend).
+        self.serving_slo = _SloCollector()
+        self.registry.register(self.serving_slo)
         # Replica-routing placement counters (rpc/router.py), set from
         # the discoverer's snapshot at scrape time like the serving
         # gauges above. Gauges rather than Counters because the
@@ -805,6 +971,7 @@ class GatewayMetrics:
             self.serving_mesh_info.labels(target, *info).set(1)
             self.serving_histograms.update(target, entry)
             self.serving_memory.update(target, entry)
+            self.serving_slo.update(target, entry)
             for unit, key in (("requests", "queuedRequests"),
                               ("tokens", "queuedTokens")):
                 self._child(
@@ -819,6 +986,7 @@ class GatewayMetrics:
                 self._children.pop((id(gauge), target), None)
             self.serving_histograms.remove(target)
             self.serving_memory.remove(target)
+            self.serving_slo.remove(target)
             prev = self._mesh_info_labels.pop(target, None)
             if prev is not None:
                 try:

@@ -31,7 +31,7 @@ Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
 _KNOWN_PATHS = frozenset(
     {"/", "/health", "/metrics", "/stats", "/debug/traces",
      "/debug/ticks", "/debug/requests", "/debug/timeline",
-     "/debug/memory", "/debug/profile",
+     "/debug/memory", "/debug/profile", "/debug/slo",
      "/admin/drain", "/admin/undrain", "/admin/fleet"}
 )
 

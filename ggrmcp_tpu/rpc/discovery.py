@@ -798,10 +798,14 @@ class ServiceDiscoverer:
         max_ticks: int = 0,
         max_requests: int = 0,
         timeout_s: float = 2.0,
+        tenant: str = "",
     ) -> list[dict[str, Any]]:
         """Flight-recorder rings from every healthy backend exposing
         DebugService.GetFlightRecord (TPU sidecars), one protojson
-        entry per backend — the /debug/ticks and /debug/requests body."""
+        entry per backend — the /debug/ticks and /debug/requests body.
+        `tenant` filters request records to one tenant's lifecycle
+        (server-side, like trace_id — the ring is scanned where it
+        lives, not shipped whole)."""
         arguments: dict[str, Any] = {}
         if trace_id:
             arguments["traceId"] = trace_id
@@ -809,6 +813,8 @@ class ServiceDiscoverer:
             arguments["maxTicks"] = int(max_ticks)
         if max_requests:
             arguments["maxRequests"] = int(max_requests)
+        if tenant:
+            arguments["tenant"] = tenant
         return await self._fanout_diagnostics(
             self.FLIGHT_RECORD_METHOD, arguments, timeout_s
         )

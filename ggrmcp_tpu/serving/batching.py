@@ -45,6 +45,7 @@ from ggrmcp_tpu.serving.engine import bucket_len, fit_request
 from ggrmcp_tpu.serving import tensors
 from ggrmcp_tpu.serving.flight_recorder import PHASE_NAMES, FlightRecorder
 from ggrmcp_tpu.serving.pages import PageAllocator, PageExhaustedError
+from ggrmcp_tpu.serving.slo import SloAccount, TenantTable
 from ggrmcp_tpu.utils import failpoints
 from ggrmcp_tpu.utils.stats import pct
 
@@ -238,6 +239,11 @@ class _Request:
     # plain one-token constrained decoding — typed, counted, never
     # silent.
     jump_degraded: bool = False
+    # Tenant & SLO identity (serving/slo.py): who this request belongs
+    # to and which QoS class judges it at the terminal chunk. Pure
+    # accounting — never consulted for placement or admission.
+    tenant: str = ""
+    qos_class: str = ""
 
 
 class ContinuousBatcher:
@@ -646,6 +652,20 @@ class ContinuousBatcher:
         self.recorder = FlightRecorder(
             getattr(getattr(engine, "serving", None), "observability", None)
         )
+        # Tenant & SLO accounting plane (serving/slo.py): per-class
+        # goodput/burn + per-tenant VTC token attribution, fed from the
+        # same terminal-chunk hook as the recorder's request ring. One
+        # account per batcher (tiers own theirs; the tiered facade
+        # merges exactly, like the latency histograms). Obs-off wins:
+        # with the recorder disabled this plane stores and computes
+        # nothing either.
+        _slo_cfg = getattr(getattr(engine, "serving", None), "slo", None)
+        self.slo = SloAccount(
+            _slo_cfg,
+            obs_enabled=self.recorder.enabled,
+            bounds=self.recorder._bounds,
+        )
+        self.tenants = TenantTable(_slo_cfg, enabled=self.slo.enabled)
         # Tick-phase attribution (flight_recorder.PhaseTimer):
         # cumulative per-phase ms over collected ticks (the ServingStats
         # tick_phase_*_ms scalars; summable across tiers), and the
@@ -2555,6 +2575,8 @@ class ContinuousBatcher:
         grammar: Optional[CompiledGrammar] = None,
         adapter_key: str = "",
         adapter_lease=None,
+        tenant: str = "",
+        qos_class: str = "",
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         """Enqueue a request; yields (token_ids_chunk, finish_reason)
         pairs; finish_reason is set on the final chunk. `unary=True`
@@ -2627,6 +2649,11 @@ class ContinuousBatcher:
         cap = self.cfg.max_pending
         if cap > 0 and self.pending.qsize() >= cap:
             self.shed += 1
+            # Submit-time shed raises before the request object exists:
+            # the SLO/tenant ledgers must still see it — typed into the
+            # unevaluated partition, never dropped from the total.
+            self.slo.record_shed(qos_class)
+            self.tenants.record_shed(tenant)
             raise OverloadedError(
                 f"admission queue full ({cap} requests pending)",
                 reason="requests",
@@ -2641,6 +2668,8 @@ class ContinuousBatcher:
             # misconfigured cap must degrade to FIFO, not to a
             # permanent 429 for every large request.
             self.shed += 1
+            self.slo.record_shed(qos_class)
+            self.tenants.record_shed(tenant)
             raise OverloadedError(
                 f"admission queue token budget full ({tcap} tokens)",
                 reason="tokens",
@@ -2654,6 +2683,7 @@ class ContinuousBatcher:
             unary=unary, adapter=adapter, trace_id=trace_id,
             n_prompt=len(prompt), grammar=handle,
             adapter_key=adapter_key, adapter_lease=adapter_lease,
+            tenant=tenant, qos_class=qos_class,
         )
         request.t_submit = time.perf_counter()
         self.pending.put_nowait(request)
@@ -2732,6 +2762,13 @@ class ContinuousBatcher:
             **self.lat_percentiles(self.lat_snapshot()),
             **self.stall_percentiles(self.stall_snapshot()),
             **self.recorder.histogram_stats(),
+            # Structured (repeated-message) SLO/tenant fragments ride
+            # OUTSIDE counter_stats: the tiered facade's sum-by-key
+            # aggregation only handles scalars — it merges these via
+            # SloAccount/TenantTable.merged_stats instead, like the
+            # histograms. Empty dicts when the plane is disabled.
+            **self.slo.stats(),
+            **self.tenants.stats(),
         }
 
     def flight_snapshot(
@@ -2739,16 +2776,21 @@ class ContinuousBatcher:
         max_ticks: int = 128,
         max_requests: int = 128,
         trace_id: str = "",
+        tenant: str = "",
     ) -> tuple[list, list]:
         """(tick records, request records), oldest first, optionally
         filtered to the records a trace id participated in — the
         DebugService.GetFlightRecord body (sidecar) and the bench's
-        TTFT source."""
+        TTFT source. `tenant` narrows the REQUEST records to one
+        tenant's (ticks are shared across tenants and stay unfiltered,
+        matching the FlightRecordRequest.tenant contract)."""
         ticks = self.recorder.tick_snapshot()
         requests = self.recorder.request_snapshot()
         if trace_id:
             ticks = [t for t in ticks if trace_id in t.trace_ids]
             requests = [r for r in requests if r.trace_id == trace_id]
+        if tenant:
+            requests = [r for r in requests if r.tenant == tenant]
         return ticks[-max(1, max_ticks):], requests[-max(1, max_requests):]
 
     def request_record(self, trace_id: str):
@@ -2995,11 +3037,49 @@ class ContinuousBatcher:
             last_tick = max(request.first_tick, self.timing["ticks"])
         else:
             last_tick = -1
+        # Tenant & SLO ledgers (serving/slo.py), same stamps and the
+        # same skip discipline as the recorder below: a never-admitted
+        # death has no latency to judge (unevaluated), TPOT needs a
+        # decode interval (>= 2 tokens). slo.enabled is False whenever
+        # the recorder is disabled, so obs-off computes none of this.
+        outcome = ""
+        if self.slo.enabled:
+            now = time.perf_counter()
+            tokens = len(request.acc)
+            admitted = bool(request.t_admit)
+            ttft_ms = (
+                max(0.0, (request.t_first - request.t_submit) * 1000.0)
+                if request.t_first else None
+            )
+            tpot_ms = (
+                (now - request.t_first) * 1000.0 / (tokens - 1)
+                if request.t_first and tokens > 1 else None
+            )
+            outcome = self.slo.record_terminal(
+                request.qos_class, reason,
+                admitted=admitted,
+                ttft_ms=ttft_ms,
+                tpot_ms=tpot_ms,
+                e2e_ms=max(0.0, (now - request.t_submit) * 1000.0),
+            )
+            self.tenants.record_terminal(
+                request.tenant,
+                admitted=admitted,
+                prompt_tokens=request.n_prompt,
+                decode_tokens=tokens,
+                queue_ms=(
+                    max(0.0, (request.t_admit - request.t_submit) * 1000.0)
+                    if request.t_admit else 0.0
+                ),
+            )
         self.recorder.record_request(
             request.trace_id, request.t_submit, request.t_admit,
             request.t_first, request.n_prompt, len(request.acc),
             reason, request.first_tick, last_tick,
             constrained=request.grammar is not None,
+            tenant=request.tenant,
+            qos_class=request.qos_class,
+            slo_violated=outcome == "violated",
         )
 
     def _replay_or_fail(self, request: _Request) -> None:

@@ -65,6 +65,7 @@ from collections import deque
 from typing import Any, Callable, Optional
 
 from ggrmcp_tpu.core.config import FleetConfig
+from ggrmcp_tpu.serving.slo import windowed_delta
 
 logger = logging.getLogger("ggrmcp.serving.fleet")
 
@@ -755,10 +756,12 @@ def hist_p99(bounds: list[float], counts: list[float]) -> float:
 class TtftWindow:
     """Per-target windowed TTFT p99 from consecutive cumulative
     snapshots: the delta of bucket counts between observes is the
-    window's histogram. A counter regression (backend restart) resets
-    the baseline. Returns the LAST computed window p99 while no new
-    observations arrive (an idle pool shouldn't read as SLO-clean one
-    step and breaching the next on stale data)."""
+    window's histogram (serving/slo.py windowed_delta — the shared
+    cumulative-counter discipline this class originated). A counter
+    regression (backend restart) resets the baseline. Returns the LAST
+    computed window p99 while no new observations arrive (an idle pool
+    shouldn't read as SLO-clean one step and breaching the next on
+    stale data)."""
 
     def __init__(self) -> None:
         self._prev: dict[str, list[float]] = {}
@@ -769,13 +772,12 @@ class TtftWindow:
         counts = [float(c) for c in entry.get("ttftMsBucket", [])]
         if not bounds or len(counts) != len(bounds) + 1:
             return self._last_p99.get(target, 0.0)
-        prev = self._prev.get(target)
-        if prev is None or len(prev) != len(counts) or any(
-            c < p for c, p in zip(counts, prev)
-        ):
+        delta = windowed_delta(self._prev.get(target), counts)
+        if delta is None:
+            # Unusable baseline (first observe, bound-config change, or
+            # counter regression): re-baseline, keep the last p99.
             self._prev[target] = counts
             return self._last_p99.get(target, 0.0)
-        delta = [c - p for c, p in zip(counts, prev)]
         if sum(delta) > 0:
             self._prev[target] = counts
             self._last_p99[target] = hist_p99(bounds, delta)

@@ -186,6 +186,14 @@ class RequestRecord:
     # tokens were DFA-masked — "why is this request's output shaped
     # like that" answered from the ring.
     constrained: bool = False
+    # Tenant & SLO identity and verdict (serving/slo.py): who the
+    # request belonged to, which QoS class judged it, and whether it
+    # landed in the `violated` partition — carried on the record so
+    # /debug/requests?tenant= and the timeline's violation instants
+    # need no re-derivation of class targets.
+    tenant: str = ""
+    qos_class: str = ""
+    slo_violated: bool = False
 
     def to_dict(self) -> dict:
         return {
@@ -202,6 +210,9 @@ class RequestRecord:
             "lastTick": self.last_tick,
             "source": self.source,
             "constrained": self.constrained,
+            "tenant": self.tenant,
+            "qosClass": self.qos_class,
+            "sloViolated": self.slo_violated,
         }
 
 
@@ -353,6 +364,9 @@ class FlightRecorder:
         first_tick: int,
         last_tick: int,
         constrained: bool = False,
+        tenant: str = "",
+        qos_class: str = "",
+        slo_violated: bool = False,
     ) -> None:
         """Record a request's terminal chunk; derives ttft/queue/e2e
         and feeds the histograms. Stamps that never happened (a timeout
@@ -383,6 +397,9 @@ class FlightRecorder:
             last_tick=last_tick,
             source=self.source,
             constrained=constrained,
+            tenant=tenant,
+            qos_class=qos_class,
+            slo_violated=slo_violated,
         )
         self._requests.append(rec)
         with self._lock:

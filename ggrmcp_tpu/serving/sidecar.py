@@ -405,6 +405,33 @@ class Sidecar:
             logger.critical("replica_crash failpoint fired: %s", exc)
             os._exit(86)
 
+    def _tenant_identity(
+        self, request: serving_pb2.GenerateRequest, context
+    ) -> tuple[str, str]:
+        """Tenant & SLO identity for this call (serving.slo,
+        serving/slo.py). Explicit GenerateRequest fields win — the
+        gateway threads x-tenant-id / x-qos-class into them — otherwise
+        derive from the forwarded gRPC metadata with the documented
+        fallback chain tenant ← x-adapter-id ← x-session-id ←
+        "default", so direct gRPC callers (no gateway in front) are
+        attributed too. qos_class passes through unvalidated: the
+        batcher's SloAccount degrades unknown names to
+        slo.default_class — measurement never rejects a request."""
+        md: dict = {}
+        for key, val in context.invocation_metadata() or ():
+            if isinstance(val, str):
+                md.setdefault(key.lower(), val)
+        tenant = (
+            request.tenant_id
+            or md.get("x-tenant-id")
+            or request.adapter
+            or md.get("x-adapter-id")
+            or md.get("x-session-id")
+            or "default"
+        )
+        qos = request.qos_class or md.get("x-qos-class") or ""
+        return str(tenant), str(qos)
+
     async def generate(self, request: serving_pb2.GenerateRequest, context):
         assert self.generation is not None and self.batcher is not None
         self._maybe_replica_crash()
@@ -479,10 +506,14 @@ class Sidecar:
                 # unary: one terminal chunk — skips per-tick
                 # cross-thread emission (batching.py _Request.unary).
                 try:
+                    tenant, qos_class = self._tenant_identity(
+                        request, context
+                    )
                     it = self.batcher.submit(
                         prompt, max_new, sampling, seed, unary=True,
                         adapter=adapter, trace_id=trace_id, grammar=grammar,
                         adapter_key=request.adapter, adapter_lease=lease,
+                        tenant=tenant, qos_class=qos_class,
                     )
                 except OverloadedError as exc:
                     # Load shedding, not failure: RESOURCE_EXHAUSTED is
@@ -591,10 +622,12 @@ class Sidecar:
             return stable[len(emitted):], stop_hit
 
         try:
+            tenant, qos_class = self._tenant_identity(request, context)
             it = self.batcher.submit(
                 prompt, max_new, self._sampling(request), seed,
                 adapter=adapter, trace_id=trace_id, grammar=grammar,
                 adapter_key=request.adapter, adapter_lease=lease,
+                tenant=tenant, qos_class=qos_class,
             )
         except OverloadedError as exc:
             # Shed before any chunk is written — same overload contract
@@ -786,10 +819,12 @@ class Sidecar:
         adapter, lease = await self._resolve_adapter(request, context)
         finish = "error"
         try:
+            tenant, qos_class = self._tenant_identity(request, context)
             it = self.batcher.submit(
                 prompt, 1, SamplingConfig(temperature=0.0), 0,
                 unary=True, trace_id=trace_id, adapter=adapter,
                 adapter_key=request.adapter, adapter_lease=lease,
+                tenant=tenant, qos_class=qos_class,
             )
         except OverloadedError as exc:
             self._release_adapter(lease)
@@ -1038,7 +1073,7 @@ class Sidecar:
                 for t in getattr(self.batcher, "tiers", [self.batcher])
             )
             ticks, requests = self.batcher.flight_snapshot(
-                max_ticks, max_requests, request.trace_id
+                max_ticks, max_requests, request.trace_id, request.tenant
             )
         if self.spec_batcher is not None:
             enabled = enabled or self.spec_batcher.recorder.enabled
@@ -1047,6 +1082,10 @@ class Sidecar:
                 spec_requests = [
                     r for r in spec_requests
                     if r.trace_id == request.trace_id
+                ]
+            if request.tenant:
+                spec_requests = [
+                    r for r in spec_requests if r.tenant == request.tenant
                 ]
             requests = sorted(
                 requests + spec_requests, key=lambda r: r.t_submit
@@ -1096,6 +1135,8 @@ class Sidecar:
                     finish_reason=r.finish_reason, decode_tps=r.decode_tps,
                     first_tick=r.first_tick, last_tick=r.last_tick,
                     source=r.source, constrained=r.constrained,
+                    tenant=r.tenant, qos_class=r.qos_class,
+                    slo_violated=r.slo_violated,
                 )
                 for r in requests
             ],

@@ -166,6 +166,8 @@ class TieredBatcher:
         grammar=None,
         adapter_key: str = "",
         adapter_lease=None,
+        tenant: str = "",
+        qos_class: str = "",
     ) -> AsyncIterator[tuple[list[int], Optional[str]]]:
         last_exc: Optional[OverloadedError] = None
         probed: list[ContinuousBatcher] = []
@@ -175,6 +177,7 @@ class TieredBatcher:
                     prompt, max_new, sampling, seed, unary=unary,
                     adapter=adapter, trace_id=trace_id, grammar=grammar,
                     adapter_key=adapter_key, adapter_lease=adapter_lease,
+                    tenant=tenant, qos_class=qos_class,
                 )
             except OverloadedError as exc:
                 last_exc = exc
@@ -182,15 +185,22 @@ class TieredBatcher:
                 continue
             # Overflow probes that a larger sibling absorbed are not
             # caller-visible sheds: un-count them so the aggregated
-            # shed_requests equals requests actually refused.
+            # shed_requests equals requests actually refused — and the
+            # SLO/tenant ledgers apply the same discipline (the
+            # absorbing tier records the eventual terminal event, so a
+            # leftover probe count would double-book the request).
             for tier in probed:
                 tier.shed -= 1
+                tier.slo.uncount_shed(qos_class)
+                tier.tenants.uncount_shed(tenant)
             return it
         # Every fitting tier is at its admission cap: shed for real —
         # ONE refusal for the caller, so keep exactly one count.
         assert last_exc is not None
         for tier in probed[:-1]:
             tier.shed -= 1
+            tier.slo.uncount_shed(qos_class)
+            tier.tenants.uncount_shed(tenant)
         raise last_exc
 
     async def acquire_adapter(self, name: str):
@@ -225,6 +235,7 @@ class TieredBatcher:
         elementwise (histograms, unlike percentiles, ARE summable —
         the whole point of exporting them)."""
         from ggrmcp_tpu.serving.flight_recorder import FlightRecorder
+        from ggrmcp_tpu.serving.slo import SloAccount, TenantTable
 
         per_tier = [t.counter_stats() for t in self.tiers]
         records: list = []
@@ -244,6 +255,13 @@ class TieredBatcher:
             **FlightRecorder.merge_histogram_stats(
                 [t.recorder.histogram_stats() for t in self.tiers]
             ),
+            # SLO/tenant ledgers merge exactly, like the histograms:
+            # partition counters and buckets sum per class/tenant, burn
+            # rates recombine from per-tier window deltas (a weighted
+            # merge, not an average of rates), and the merged tenant
+            # view re-applies the cardinality bound.
+            **SloAccount.merged_stats([t.slo for t in self.tiers]),
+            **TenantTable.merged_stats([t.tenants for t in self.tiers]),
         }
 
     def flight_snapshot(
@@ -251,6 +269,7 @@ class TieredBatcher:
         max_ticks: int = 128,
         max_requests: int = 128,
         trace_id: str = "",
+        tenant: str = "",
     ) -> tuple[list, list]:
         """Merged per-tier flight records, ordered by wall-clock stamp
         (tick seq counters are per-tier; `source` disambiguates)."""
@@ -258,7 +277,7 @@ class TieredBatcher:
         requests: list = []
         for tier in self.tiers:
             t_ticks, t_requests = tier.flight_snapshot(
-                max_ticks, max_requests, trace_id
+                max_ticks, max_requests, trace_id, tenant
             )
             ticks.extend(t_ticks)
             requests.extend(t_requests)

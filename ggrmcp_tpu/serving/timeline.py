@@ -243,6 +243,12 @@ def _request_events(requests: list, pid: int, events: list) -> None:
                 "lastTick": int(_f(req.get("lastTick"), -1.0)),
                 "source": req.get("source", ""),
                 "constrained": bool(req.get("constrained", False)),
+                # SLO-plane identity (serving/slo.py): who this request
+                # was, which objective class it rode under, and whether
+                # it burned the class's error budget.
+                "tenant": req.get("tenant", ""),
+                "qosClass": req.get("qosClass", ""),
+                "sloViolated": bool(req.get("sloViolated", False)),
             },
         })
         if reason in _FAILURE_REASONS:
@@ -250,6 +256,22 @@ def _request_events(requests: list, pid: int, events: list) -> None:
                 "ph": "i", "cat": "lifecycle", "name": reason,
                 "ts": start_us + dur_us, "s": "t",
                 "pid": pid, "tid": tid, "args": {"traceId": trace_id},
+            })
+        elif bool(req.get("sloViolated", False)):
+            # A request that FINISHED fine but missed its class's
+            # latency objective: full-height (global-scope) instant —
+            # like post-warmup compiles, the steady-state regression
+            # signal should not hide at thread height. Failure reasons
+            # above already mark the row; the SLO marker covers the
+            # met-but-slow case they can't.
+            events.append({
+                "ph": "i", "cat": "slo", "name": "slo-violation",
+                "ts": start_us + dur_us, "s": "g",
+                "pid": pid, "tid": tid, "args": {
+                    "traceId": trace_id,
+                    "tenant": req.get("tenant", ""),
+                    "qosClass": req.get("qosClass", ""),
+                },
             })
 
 

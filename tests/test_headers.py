@@ -77,3 +77,18 @@ def test_default_config_policy_suite():
     for h in ["cookie", "set-cookie", "host", "content-length", "te",
               "transfer-encoding", "proxy-authorization"]:
         assert not f.should_forward(h), h
+
+
+def test_identity_headers_forwarded_by_default():
+    """The multi-tenant identity headers ride the default allowlist:
+    x-adapter-id (adapter binding, docs/multi_lora.md) and the SLO
+    plane's x-tenant-id / x-qos-class (serving/slo.py) must reach the
+    sidecar as gRPC metadata without operator config."""
+    f = make_filter()
+    for h in ["x-adapter-id", "x-tenant-id", "x-qos-class",
+              "X-Tenant-Id", "X-QoS-Class"]:
+        assert f.should_forward(h), h
+    md = dict(f.to_grpc_metadata({
+        "X-Tenant-Id": "acme", "X-QoS-Class": "interactive"
+    }))
+    assert md == {"x-tenant-id": "acme", "x-qos-class": "interactive"}

@@ -129,10 +129,18 @@ class TestProtoDrift:
             "tick_state",
         }
         assert not (gauges & infos)
+        # Repeated MESSAGE fields carry structured per-class/per-tenant
+        # tables: the SLO classes export through the class-labeled
+        # _SloCollector families, the tenant table through /debug/slo
+        # ONLY (tenant is an unbounded Prometheus label). A NEW message
+        # field must be named here with its export surface — the
+        # covered-loop below rejects it otherwise.
+        structured = {"slo_classes", "tenants"}
         for field in desc.fields:
             covered = (
                 field.name in gauges
                 or field.name in infos
+                or field.name in structured
                 or field.name in {
                     f"memory_{m}_bytes" for m in memory
                 }
@@ -144,6 +152,16 @@ class TestProtoDrift:
                 or field.name == "latency_bucket_bounds_ms"
             )
             assert covered, f"ServingStats field {field.name} not exported"
+        assert structured == {
+            f.name for f in desc.fields
+            if f.cpp_type == f.CPPTYPE_MESSAGE
+        }
+        # The SLO cross-class totals export as plain gauges.
+        assert {
+            "slo_met_total", "slo_violated_total",
+            "slo_unevaluated_total", "slo_tenants_tracked",
+            "slo_tenant_evictions",
+        } <= gauges
         # The TP-serving identity fields must stay exported as gauges —
         # the anti-masquerade contract (docs/tensor_parallel_serving.md).
         assert {"tp_chips", "mesh_devices", "mesh_spec_downgrades"} <= gauges
