@@ -9,6 +9,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
   test-obs test-grammar test-grammar-jump test-spec-batch test-paged \
   test-tp test-analysis \
   test-disagg test-fleet test-mem test-kvtier test-lora-arena test-slo \
+  test-sched \
   bench-cpu \
   smoke e2e lint graftlint ci-local preflight clean
 
@@ -184,6 +185,17 @@ test-lora-arena:
 # target is the fast inner loop for serving/slo.py work.
 test-slo:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m slo
+
+# Preemptive SLO-aware scheduler net (tests/test_scheduler.py): queue
+# priority/fair-share/lane-routing units, policy triggers + victim
+# selection, the per-class Retry-After ladder, preempt-resume greedy
+# bit-identity across plain/paged/host-tier/adapter/tiered paths,
+# chaos (sched_preempt_fail, tick faults mid-preempt, host_restore_fail
+# on resume, arena exhaustion → typed shed), and the prefill token
+# budget. Tier-1 runs these too; this target is the fast inner loop
+# for serving/scheduler.py work.
+test-sched:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m sched
 
 # ruff if present (baked CI image installs it; the TPU image may not).
 lint:

@@ -129,6 +129,23 @@ Environment knobs:
                          docs/observability.md "SLO plane"). Knobs:
                          GGRMCP_BENCH_TENANT_COUNT (10),
                          GGRMCP_BENCH_TENANT_CALLS (4 per tenant).
+  GGRMCP_BENCH_SCHED     preemptive scheduler phase ("on" by default
+                         off-TPU, "off" skips): mixed-priority ~10x
+                         overload (long background calls saturating a
+                         2-slot batcher while short interactive calls
+                         arrive) run twice on one engine — scheduler
+                         OFF (FCFS) vs ON (QoS priority + VTC fair
+                         share + demote-don't-kill preemption).
+                         Exports per-class client-side TTFT/TPOT p99
+                         for both sides, the unloaded interactive
+                         baseline (the 1.5x acceptance ratio's
+                         denominator), the off/on TTFT improvement
+                         ratio, preempt/resume/parked counters, and
+                         the per-tenant fairness spread (sched_*
+                         extras + bench_artifacts/sched.json;
+                         docs/scheduling.md). Knobs:
+                         GGRMCP_BENCH_SCHED_BG (6 background calls),
+                         GGRMCP_BENCH_SCHED_IA (16 interactive calls).
   GGRMCP_BENCH_REPLICAS=N  N-replica routing phase (standalone mode,
                          like PROXY_ONLY): spins N paged-KV sidecar
                          replica PROCESSES behind one gateway and
@@ -1463,6 +1480,21 @@ async def _run_bench() -> dict:
         except Exception as exc:  # secondary phase must not sink the run
             print(f"bench: tenants phase failed: {exc!r}", file=sys.stderr)
 
+    # Preemptive scheduler A/B (GGRMCP_BENCH_SCHED,
+    # docs/scheduling.md): same isolation rationale — runs after the
+    # serving stack is down, on its own batchers.
+    sched = {}
+    want_sched = os.environ.get("GGRMCP_BENCH_SCHED")
+    if want_sched == "on" or (
+        want_sched is None and not headline_only and not on_tpu
+    ):
+        try:
+            sched = await _sched_bench(
+                model, max_new, tick_steps, quantize, kv_dtype, synth,
+            )
+        except Exception as exc:  # secondary phase must not sink the run
+            print(f"bench: sched phase failed: {exc!r}", file=sys.stderr)
+
     # Tensor-parallel serving A/B (GGRMCP_BENCH_TP,
     # docs/tensor_parallel_serving.md): same isolation rationale —
     # runs after the serving stack is down, on its own engines.
@@ -1488,7 +1520,7 @@ async def _run_bench() -> dict:
     return {
         **headline, **hbm, **obs_export, **prefix, **longp, **mixed,
         **grammar, **ticktime, **specbatch, **jump, **paged, **kvtier,
-        **lora, **tenants,
+        **lora, **tenants, **sched,
         **tp, **proxy,
     }
 
@@ -1819,6 +1851,219 @@ async def _tenants_bench(
             )
     except OSError as exc:  # artifact write must not sink the phase
         print(f"bench: tenants artifact write failed: {exc}",
+              file=sys.stderr)
+    return out
+
+
+async def _sched_bench(
+    model: str, max_new: int, tick_steps, quantize: str, kv_dtype: str,
+    synth: bool,
+) -> dict:
+    """Preemptive SLO-aware scheduler A/B (serving/scheduler.py,
+    docs/scheduling.md): one engine, one mixed-priority overload plan,
+    two batchers — scheduler OFF (FCFS admission) vs ON (QoS priority
+    queues + VTC fair share + demote-don't-kill preemption). The plan
+    saturates a 2-slot paged batcher with long background calls
+    (~10x offered load vs capacity) while short interactive calls
+    arrive behind them; the claim under test is that the scheduler
+    holds interactive p99 TTFT/TPOT near the unloaded baseline while
+    background absorbs the damage. Exports per-class client-side
+    TTFT/TPOT p99 for both sides, the unloaded interactive baseline
+    (the acceptance ratio's denominator), preempt/resume counters, and
+    the per-tenant weighted-token fairness spread. Full detail rides
+    bench_artifacts/sched.json."""
+    import asyncio as _asyncio
+    import dataclasses as _dataclasses
+
+    from ggrmcp_tpu.core.config import (
+        BatchingConfig, MeshConfig, ObservabilityConfig, SchedulerConfig,
+        ServingConfig, SloConfig,
+    )
+    from ggrmcp_tpu.models import get_model
+    from ggrmcp_tpu.ops.sampling import SamplingConfig
+    from ggrmcp_tpu.serving.batching import ContinuousBatcher
+    from ggrmcp_tpu.serving.engine import GenerationEngine
+    from ggrmcp_tpu.utils.stats import pct
+
+    n_bg = int(os.environ.get("GGRMCP_BENCH_SCHED_BG", "6"))
+    n_ia = int(os.environ.get("GGRMCP_BENCH_SCHED_IA", "16"))
+    budget = max(8, max_new)
+    _, mcfg = get_model(model)
+    engine = GenerationEngine(mcfg, ServingConfig(
+        model=model, quantize=quantize, kv_cache_dtype=kv_dtype,
+        synthetic_weights=synth, mesh=MeshConfig(),
+        observability=ObservabilityConfig(enabled=True),
+        # Interactive gets a CPU-stand-in-reachable TTFT objective (the
+        # wait-fraction preempt trigger keys on it); batch/background
+        # targets are loose — they absorb the overload by design.
+        slo=SloConfig(classes={
+            "interactive": {"ttft_p99_ms": 50.0, "tpot_p99_ms": 50.0},
+            "batch": {"ttft_p99_ms": 60000.0, "tpot_p99_ms": 10000.0},
+            "background": {
+                "ttft_p99_ms": 120000.0, "tpot_p99_ms": 10000.0,
+            },
+        }, default_class="background"),
+        scheduler=SchedulerConfig(enabled=True),
+    ))
+    greedy = SamplingConfig(temperature=0.0)
+    batch_cfg = BatchingConfig(
+        max_batch_size=2, kv_cache_max_seq=512,
+        decode_steps_per_tick=tick_steps,
+        paged_kv="on", paged_kv_page_size=16, paged_kv_pages=64,
+        paged_kv_host_bytes=256 << 20,
+    )
+
+    def engine_view(sched_on: bool):
+        if sched_on:
+            return engine
+        off = _dataclasses.replace(
+            engine.serving, scheduler=SchedulerConfig()
+        )
+
+        class _Shim:
+            def __getattr__(self, name):
+                return getattr(engine, name)
+
+        shim = _Shim()
+        shim.__dict__["serving"] = off
+        return shim
+
+    async def run_side(sched_on: bool) -> dict:
+        batcher = ContinuousBatcher(engine_view(sched_on), batch_cfg)
+        loop = _asyncio.get_running_loop()
+        await loop.run_in_executor(None, batcher.warmup)
+        batcher.start()
+        lat: dict[str, list[tuple[float, float, int]]] = {}
+
+        async def call(k: int, qos: str, tenant: str, prompt_n: int,
+                       new: int):
+            prompt = [
+                3 + (hash((qos, tenant, k, i)) % 200)
+                for i in range(prompt_n)
+            ]
+            t0 = time.perf_counter()
+            first, n_tok = None, 0
+            async for ids, _reason in batcher.submit(
+                prompt, new, greedy, seed=k,
+                tenant=tenant, qos_class=qos,
+            ):
+                n_tok += len(ids)
+                if first is None and ids:
+                    first = (time.perf_counter() - t0) * 1000.0
+            lat.setdefault(qos, []).append(
+                (first or 0.0, (time.perf_counter() - t0) * 1000.0,
+                 n_tok)
+            )
+
+        side: dict = {}
+        try:
+            # Unloaded interactive baseline (sched-on side only; the
+            # config doesn't change an idle batcher's latency).
+            if sched_on:
+                for k in range(5):
+                    await call(k, "interactive", "ia-base", 6,
+                               max(2, budget // 2))
+                # Call 0 pays the prefill-shape compile — the unloaded
+                # baseline is the WARM p99, same as the loaded side.
+                side["unloaded_interactive_ttft_p99_ms"] = round(
+                    pct([p[0] for p in lat["interactive"][1:]], 0.99), 2
+                )
+                lat.clear()
+            # Overload: long background/batch calls flood the 2-slot
+            # batcher (~10x offered load vs capacity); the interactive
+            # stream arrives SEQUENTIALLY behind it — a latency-
+            # sensitive probe, not a second flood (16 concurrent
+            # interactive calls through 2 slots would measure
+            # intra-class queueing, which no scheduler can remove).
+            tasks = [
+                _asyncio.ensure_future(call(
+                    k, "background" if k % 2 else "batch",
+                    f"bulk{k % 3}", 16, budget * 3,
+                ))
+                for k in range(n_bg)
+            ]
+            await _asyncio.sleep(0.05)  # let the bulk wave admit
+            t0 = time.perf_counter()
+            for k in range(n_ia):
+                await call(100 + k, "interactive", f"ia{k % 4}", 6,
+                           max(2, budget // 2))
+            await _asyncio.gather(*tasks)
+            side["elapsed_s"] = round(time.perf_counter() - t0, 2)
+            stats = batcher.stats()
+        finally:
+            await batcher.stop()
+        for qos, triples in sorted(lat.items()):
+            side[f"{qos}_ttft_p99_ms"] = round(
+                pct([p[0] for p in triples], 0.99), 2
+            )
+            tpots = [
+                (p[1] - p[0]) / (p[2] - 1)
+                for p in triples if p[2] > 1
+            ]
+            if tpots:
+                side[f"{qos}_tpot_p99_ms"] = round(pct(tpots, 0.99), 2)
+        side["preemptions"] = stats.get("sched_preemptions", 0)
+        side["resumes"] = stats.get("sched_resumes", 0)
+        side["preempt_failures"] = stats.get("sched_preempt_failures", 0)
+        side["parked_at_end"] = stats.get("sched_parked", 0)
+        side["budget_deferrals"] = stats.get("sched_budget_deferrals", 0)
+        rows = stats.get("tenants", [])
+        weighted = [r["weighted_tokens"] for r in rows if r["tenant"]]
+        if weighted:
+            side["weighted_tokens_top"] = round(max(weighted), 1)
+            side["weighted_tokens_bottom"] = round(min(weighted), 1)
+        return side
+
+    off = await run_side(False)
+    on = await run_side(True)
+    out: dict = {
+        "sched_calls": n_bg + n_ia,
+        "sched_unloaded_interactive_ttft_p99_ms": on.get(
+            "unloaded_interactive_ttft_p99_ms", 0.0
+        ),
+        "sched_off_interactive_ttft_p99_ms": off.get(
+            "interactive_ttft_p99_ms", 0.0
+        ),
+        "sched_on_interactive_ttft_p99_ms": on.get(
+            "interactive_ttft_p99_ms", 0.0
+        ),
+        "sched_off_interactive_tpot_p99_ms": off.get(
+            "interactive_tpot_p99_ms", 0.0
+        ),
+        "sched_on_interactive_tpot_p99_ms": on.get(
+            "interactive_tpot_p99_ms", 0.0
+        ),
+        "sched_preemptions": on["preemptions"],
+        "sched_resumes": on["resumes"],
+        "sched_parked_at_end": on["parked_at_end"],
+    }
+    if on.get("interactive_ttft_p99_ms"):
+        out["sched_ttft_improvement_x"] = round(
+            off.get("interactive_ttft_p99_ms", 0.0)
+            / on["interactive_ttft_p99_ms"], 2
+        )
+        base = on.get("unloaded_interactive_ttft_p99_ms", 0.0)
+        if base:
+            out["sched_on_ttft_vs_unloaded_x"] = round(
+                on["interactive_ttft_p99_ms"] / base, 2
+            )
+    # A parked request left behind would be a scheduler bug — surface
+    # it loudly in the artifact, not silently in an unread gauge.
+    assert on["parked_at_end"] == 0, "requests left parked after drain"
+    try:
+        art_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_artifacts"
+        )
+        os.makedirs(art_dir, exist_ok=True)
+        with open(
+            os.path.join(art_dir, "sched.json"), "w", encoding="utf-8",
+        ) as fh:
+            json.dump(
+                {**out, "scheduler_off": off, "scheduler_on": on},
+                fh, indent=1, sort_keys=True,
+            )
+    except OSError as exc:  # artifact write must not sink the phase
+        print(f"bench: sched artifact write failed: {exc}",
               file=sys.stderr)
     return out
 

@@ -564,6 +564,69 @@ class SloConfig:
 
 
 @dataclass
+class SchedulerConfig:
+    """Preemptive SLO-aware scheduler (serving/scheduler.py,
+    docs/scheduling.md): QoS-class priority queues with VTC fair share
+    inside each class, demote-don't-kill preemption of low-priority
+    decode slots when the high-priority class is about to breach its
+    TTFT objective, and a Sarathi-style per-round prefill token budget
+    so long-prompt admission never stalls interactive decode. Off by
+    default: admission stays plain FIFO (_PendingQueue) and none of
+    the knobs below influence placement. The per-class Retry-After
+    derivation is the one surface that works even with the scheduler
+    disabled — shed backoff cooperating with class priority costs
+    nothing and fixes the flat-1s satellite."""
+
+    enabled: bool = False
+    # QoS class priority order, HIGHEST first. Names resolve against
+    # serving.slo.classes (the scheduler consumes the measurement
+    # plane's vocabulary — it never defines its own). A request whose
+    # class is missing from this list schedules at the LAST (lowest)
+    # listed class's priority.
+    classes: list = field(
+        default_factory=lambda: ["interactive", "batch", "background"]
+    )
+    # Preemption (demote-don't-kill): when the top waiting class has
+    # no free slot and its objective is at risk, demote the
+    # lowest-priority active slot — paged KV pages register + demote
+    # to the host tier, the adapter lease releases back to the arena,
+    # and the request parks for resume. False = priority queues and
+    # fair share only, never touch running slots.
+    preemption: bool = True
+    # Preempt when the top waiting class's head-of-queue wait exceeds
+    # this fraction of the class's TTFT p99 target (deterministic
+    # trigger), OR its fast-window burn rate meets the threshold
+    # below (load-signal trigger). Either alone suffices.
+    preempt_wait_fraction: float = 0.5
+    preempt_burn_threshold: float = 1.0
+    # At most this many victims demoted per loop turn: preemption is
+    # a scalpel, not a purge — one slot per turn keeps the executor
+    # stream's demote work bounded by one admission's worth.
+    max_preempts_per_turn: int = 1
+    # A resumed request whose adapter row cannot be reacquired
+    # (arena exhausted — every row pinned) re-parks and retries this
+    # many times before shedding typed ("overloaded").
+    resume_retry_limit: int = 8
+    # Sarathi-style stall-free admission: cap the prompt tokens one
+    # admission round may prefill while decode slots are active (the
+    # chunked-prefill budget as a tick-time control knob). 0 = off.
+    # Deferred requests requeue at the head — delayed one tick, never
+    # starved, never reordered.
+    prefill_budget_tokens: int = 0
+    # TenantTable.shares() snapshot TTL (seconds) for fair-share
+    # ordering — the scheduler reads live VTC counters at most this
+    # often, so queue pops stay O(lanes) instead of O(tenants).
+    shares_ttl_s: float = 0.05
+    # Per-class Retry-After derivation for shed responses: class at
+    # priority index i advertises base * factor**i seconds
+    # (interactive 1s, batch 2s, background 4s at the defaults) —
+    # background backs off longer, so retry pressure drains from the
+    # classes the scheduler protects first.
+    retry_after_base_s: float = 1.0
+    retry_after_factor: float = 2.0
+
+
+@dataclass
 class GrammarConfig:
     """Schema-constrained decoding (ggrmcp_tpu/grammar): compile MCP
     tool output schemas into token-level DFAs and enforce them
@@ -874,6 +937,11 @@ class ServingConfig:
     # Tenant & SLO accounting plane (per-class goodput/burn, per-tenant
     # VTC token attribution) — SloConfig.
     slo: "SloConfig" = field(default_factory=lambda: SloConfig())
+    # Preemptive SLO-aware scheduler (QoS priority queues, VTC fair
+    # share, demote-don't-kill preemption) — SchedulerConfig.
+    scheduler: "SchedulerConfig" = field(
+        default_factory=lambda: SchedulerConfig()
+    )
 
 
 @dataclass
@@ -1142,6 +1210,68 @@ class Config:
             raise ValueError(
                 "serving.slo.vtc_prompt_weight/vtc_decode_weight must "
                 "be >= 0"
+            )
+        sched = self.serving.scheduler
+        if not isinstance(sched.classes, list) or not sched.classes or not all(
+            isinstance(c, str) and c for c in sched.classes
+        ):
+            raise ValueError(
+                "serving.scheduler.classes must be a non-empty list of "
+                "class names, highest priority first"
+            )
+        if len(set(sched.classes)) != len(sched.classes):
+            raise ValueError(
+                "serving.scheduler.classes must not repeat a class name"
+            )
+        if sched.enabled:
+            unknown = [c for c in sched.classes if c not in slo.classes]
+            if unknown:
+                # The scheduler consumes the SLO plane's vocabulary:
+                # a priority class with no objectives has no TTFT
+                # target to trigger preemption against.
+                raise ValueError(
+                    f"serving.scheduler.classes {unknown} are not in "
+                    f"serving.slo.classes {sorted(slo.classes)}"
+                )
+            if not slo.enabled or not self.serving.observability.enabled:
+                raise ValueError(
+                    "serving.scheduler.enabled requires serving.slo."
+                    "enabled and serving.observability.enabled (the "
+                    "scheduler orders by live VTC counters and triggers "
+                    "preemption off burn rate — both live in the SLO "
+                    "plane)"
+                )
+        if not 0 < sched.preempt_wait_fraction <= 10:
+            raise ValueError(
+                "serving.scheduler.preempt_wait_fraction must be in "
+                "(0, 10] (fraction of the class TTFT target)"
+            )
+        if sched.preempt_burn_threshold <= 0:
+            raise ValueError(
+                "serving.scheduler.preempt_burn_threshold must be > 0"
+            )
+        if sched.max_preempts_per_turn < 0:
+            raise ValueError(
+                "serving.scheduler.max_preempts_per_turn must be >= 0"
+            )
+        if sched.resume_retry_limit < 0:
+            raise ValueError(
+                "serving.scheduler.resume_retry_limit must be >= 0"
+            )
+        if sched.prefill_budget_tokens < 0:
+            raise ValueError(
+                "serving.scheduler.prefill_budget_tokens must be >= 0 "
+                "(0 disables the per-round prefill budget)"
+            )
+        if sched.shares_ttl_s < 0:
+            raise ValueError(
+                "serving.scheduler.shares_ttl_s must be >= 0"
+            )
+        if sched.retry_after_base_s <= 0 or sched.retry_after_factor < 1:
+            raise ValueError(
+                "serving.scheduler.retry_after_base_s must be > 0 and "
+                "retry_after_factor >= 1 (lower-priority classes must "
+                "never be told to retry SOONER)"
             )
         so = self.gateway.structured_output
         if not isinstance(so, dict) or not all(

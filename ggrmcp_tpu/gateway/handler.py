@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import re
 import time
 from typing import Any, Optional
 
@@ -48,9 +49,24 @@ logger = logging.getLogger("ggrmcp.gateway.handler")
 SESSION_HEADER = "Mcp-Session-Id"
 TRACE_RESPONSE_HEADER = "X-Trace-Id"
 
-# What the backend suggests (and the gateway's Retry-After advertises)
-# when a call is shed with RESOURCE_EXHAUSTED.
+# What the gateway's Retry-After advertises when a call is shed with
+# RESOURCE_EXHAUSTED and the backend's status details carry no explicit
+# backoff. Backends with the SLO scheduler config encode a per-QoS-class
+# "retry in Ns" hint in the details (serving/scheduler.py
+# retry_after_for — background backs off geometrically longer than
+# interactive), parsed by _RETRY_IN below; this flat fallback covers old
+# backends and non-generate overloads.
 OVERLOAD_RETRY_AFTER_S = 1
+# Matches the sidecar's overload-detail suffix, e.g.
+# "server overloaded (tokens): ...; retry in 4s".
+_RETRY_IN = re.compile(r"retry in ([0-9]+(?:\.[0-9]+)?)s\b")
+
+
+def _retry_after_from_details(details: str) -> float:
+    """Per-class Retry-After from a RESOURCE_EXHAUSTED status detail
+    string, falling back to the flat contract when absent."""
+    m = _RETRY_IN.search(details or "")
+    return float(m.group(1)) if m else OVERLOAD_RETRY_AFTER_S
 # /health reports "degraded" while any backend shed within this window:
 # a scrape between shed bursts must not flap back to "healthy" while
 # the overload is plainly ongoing.
@@ -526,7 +542,9 @@ class MCPHandler:
                 raise mcp.MCPError(
                     mcp.OVERLOADED,
                     sanitize_error(f"backend overloaded: {exc.details()}"),
-                    data={"retryAfterS": OVERLOAD_RETRY_AFTER_S},
+                    data={"retryAfterS": _retry_after_from_details(
+                        exc.details()
+                    )},
                 )
             # Backend failure → IsError result, NOT a protocol error
             # (handler.go:252-259 behavior, carried over). UsageError

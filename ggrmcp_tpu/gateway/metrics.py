@@ -243,6 +243,27 @@ _SERVING_HELP = {
     "slo_tenant_evictions":
         "tenant rows LRU-folded into the ~overflow bucket under "
         "cardinality churn (counters conserve)",
+    # Preemptive SLO-aware scheduler (serving/scheduler.py,
+    # docs/scheduling.md): demote-don't-kill preemption cycle + the
+    # Sarathi prefill-budget knob. All zeros when serving.scheduler is
+    # off.
+    "sched_preemptions":
+        "victim slots demoted, not killed: KV parked to the host "
+        "tier, adapter lease released, request parked in its class's "
+        "resume lane",
+    "sched_resumes":
+        "parked requests re-activated (pages restored with one "
+        "batched H2D or recomputed — greedy output bit-identical "
+        "either way)",
+    "sched_preempt_failures":
+        "preempt ops that degraded typed — the victim keeps decoding "
+        "unharmed, never a silent loss",
+    "sched_parked":
+        "requests currently demoted-and-parked (resume-lane depth; "
+        "each holds host-tier KV awaiting restore)",
+    "sched_budget_deferrals":
+        "admissions pushed to the next cycle by the Sarathi-style "
+        "prefill token budget (scheduler.prefill_budget_tokens)",
 }
 
 _SERVING_HIST_HELP = {
@@ -613,6 +634,9 @@ class _SloCollector:
       sum to the class's total requests EXACTLY)
     - gateway_backend_slo_burn_rate{target, class, window} — SRE
       multi-window error-budget burn (window = seconds, e.g. "300")
+    - gateway_backend_slo_sheds{target, class} — submit-time 429s by
+      class (a subset of unevaluated): who absorbs the damage under
+      overload, judged against the per-class Retry-After ladder
     - gateway_backend_slo_target_ms{target, class, metric} — the
       configured p99 targets (metric = ttft|tpot), exported so alert
       rules and dashboards read objectives from the SAME scrape as the
@@ -649,6 +673,12 @@ class _SloCollector:
             "window label is seconds)",
             labels=["target", "class", "window"],
         )
+        sheds = GaugeMetricFamily(
+            "gateway_backend_slo_sheds",
+            "Backend SLO plane: submit-time sheds (429s) by class — a "
+            "subset of the unevaluated partition",
+            labels=["target", "class"],
+        )
         target_ms = GaugeMetricFamily(
             "gateway_backend_slo_target_ms",
             "Backend SLO plane: configured per-class p99 latency "
@@ -675,6 +705,7 @@ class _SloCollector:
                     burn.add_metric(
                         [target, name, f"{window_s:g}"], rate
                     )
+                sheds.add_metric([target, name], cls["sheds"])
                 for metric, value in (
                     ("ttft", cls["ttft_target_ms"]),
                     ("tpot", cls["tpot_target_ms"]),
@@ -683,6 +714,7 @@ class _SloCollector:
         yield hist
         yield requests
         yield burn
+        yield sheds
         yield target_ms
 
     def update(self, target: str, per_backend_entry: dict) -> None:
@@ -718,6 +750,7 @@ class _SloCollector:
                 "met": float(cls.get("met", 0)),
                 "violated": float(cls.get("violated", 0)),
                 "unevaluated": float(cls.get("unevaluated", 0)),
+                "sheds": float(cls.get("sheds", 0)),
                 "burn": list(zip(
                     (float(w) for w in cls.get("burnWindowS", [])),
                     (float(r) for r in cls.get("burnRate", [])),

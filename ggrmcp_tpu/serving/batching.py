@@ -41,10 +41,16 @@ from ggrmcp_tpu.ops.sampling import (
     masked_sample_dynamic,
     sample_dynamic,
 )
+from ggrmcp_tpu.serving.adapter_arena import AdapterExhaustedError
 from ggrmcp_tpu.serving.engine import bucket_len, fit_request
 from ggrmcp_tpu.serving import tensors
 from ggrmcp_tpu.serving.flight_recorder import PHASE_NAMES, FlightRecorder
 from ggrmcp_tpu.serving.pages import PageAllocator, PageExhaustedError
+from ggrmcp_tpu.serving.scheduler import (
+    Scheduler,
+    SchedulerQueue,
+    retry_after_for,
+)
 from ggrmcp_tpu.serving.slo import SloAccount, TenantTable
 from ggrmcp_tpu.utils import failpoints
 from ggrmcp_tpu.utils.stats import pct
@@ -240,10 +246,24 @@ class _Request:
     # silent.
     jump_degraded: bool = False
     # Tenant & SLO identity (serving/slo.py): who this request belongs
-    # to and which QoS class judges it at the terminal chunk. Pure
-    # accounting — never consulted for placement or admission.
+    # to and which QoS class judges it at the terminal chunk. With the
+    # scheduler off this stays pure accounting; scheduler on, it also
+    # keys the priority lane and fair-share order (serving/scheduler).
     tenant: str = ""
     qos_class: str = ""
+    # Preemption bookkeeping (serving/scheduler.py): how many times
+    # this request was demoted-and-parked (routes the re-put into the
+    # resume lane; preemption does NOT burn a tick retry — the fold is
+    # the same, the cause is policy, not failure), and how many resume
+    # attempts died on adapter-arena pressure (bounded by
+    # scheduler.resume_retry_limit before a typed shed).
+    preempts: int = 0
+    sched_retries: int = 0
+    # True while demoted-and-parked (set at park, cleared at the
+    # resuming activation): pairs every `sched_resumes` increment with
+    # exactly one preemption even when a tick-failure replay re-admits
+    # the same request in between.
+    parked: bool = False
 
 
 class ContinuousBatcher:
@@ -666,6 +686,25 @@ class ContinuousBatcher:
             bounds=self.recorder._bounds,
         )
         self.tenants = TenantTable(_slo_cfg, enabled=self.slo.enabled)
+        # Preemptive SLO-aware scheduler (serving/scheduler.py): when
+        # enabled, the FCFS pending queue is REPLACED by the priority +
+        # fair-share SchedulerQueue (same interface — the admission
+        # loop's control flow is untouched) and the policy object
+        # decides demote-don't-kill preemption once per loop cycle.
+        # Off (default): None, zero new work on any hot path.
+        self.sched_cfg = getattr(
+            getattr(engine, "serving", None), "scheduler", None
+        )
+        self.sched: Optional[Scheduler] = None
+        if self.sched_cfg is not None and getattr(
+            self.sched_cfg, "enabled", False
+        ):
+            self.sched = Scheduler(
+                self.sched_cfg, slo=self.slo, tenants=self.tenants
+            )
+            self.pending = SchedulerQueue(
+                self.sched_cfg, tenants=self.tenants
+            )
         # Tick-phase attribution (flight_recorder.PhaseTimer):
         # cumulative per-phase ms over collected ticks (the ServingStats
         # tick_phase_*_ms scalars; summable across tiers), and the
@@ -2082,6 +2121,13 @@ class ContinuousBatcher:
         slot.reserved = False
         request.t_admit = time.perf_counter()
         request.queue_ms = (request.t_admit - request.t_submit) * 1000.0
+        if request.parked:
+            # Resume completes a preempt cycle (serving/scheduler.py):
+            # the parked request is decoding again, its demoted pages
+            # restored (or recomputed) by the prefill that just ran.
+            request.parked = False
+            if self.sched is not None:
+                self.sched.resumes += 1
         # First decode tick this request can participate in is the NEXT
         # dispatch (ticks is the count of dispatched ticks; records are
         # 1-based on the same counter).
@@ -2657,6 +2703,7 @@ class ContinuousBatcher:
             raise OverloadedError(
                 f"admission queue full ({cap} requests pending)",
                 reason="requests",
+                retry_after_s=retry_after_for(self.sched_cfg, qos_class),
             )
         tcap = self.cfg.max_queue_tokens
         if (
@@ -2673,6 +2720,7 @@ class ContinuousBatcher:
             raise OverloadedError(
                 f"admission queue token budget full ({tcap} tokens)",
                 reason="tokens",
+                retry_after_s=retry_after_for(self.sched_cfg, qos_class),
             )
         # Arena residency is taken HERE (host-side bookkeeping only —
         # the device upload happens lazily in the executor), after the
@@ -2877,6 +2925,22 @@ class ContinuousBatcher:
             "shed_requests": self.shed,
             "replayed_requests": self.replayed,
             "replay_exhausted": self.replay_exhausted,
+            # Preemptive scheduler plane (serving/scheduler.py; all 0
+            # when serving.scheduler is off): demote-don't-kill
+            # preemptions, completed resumes, typed preempt failures,
+            # the currently-parked gauge (resume-lane depth — every
+            # entry holds host-tier KV), and admissions deferred by
+            # the Sarathi prefill token budget.
+            **(
+                self.sched.counter_stats(
+                    parked=self.pending.parked_count()
+                )
+                if self.sched is not None else {
+                    "sched_preemptions": 0, "sched_resumes": 0,
+                    "sched_preempt_failures": 0, "sched_parked": 0,
+                    "sched_budget_deferrals": 0,
+                }
+            ),
             # Paged KV plane (batching.paged_kv=on; all 0 when off):
             # arena occupancy gauges plus the sharing counters — pages
             # resident (live + reuse cache), pages referenced by 2+
@@ -2976,6 +3040,8 @@ class ContinuousBatcher:
         loop = asyncio.get_running_loop()
         while not self._stopping:
             await self._drain_host_ops(loop)
+            if self.sched is not None:
+                await self._maybe_preempt(loop)
             admitted = await self._admit()
             if self._active_count() == 0 and not self._ilv_busy():
                 if self._inflight:
@@ -3124,6 +3190,235 @@ class ContinuousBatcher:
         self.pending.requeue_front(request)
         self._wake.set()
 
+    # -- preemption (serving/scheduler.py) ----------------------------------
+
+    async def _maybe_preempt(self, loop) -> None:
+        """One scheduling decision per loop cycle: if the
+        highest-priority waiter is at risk (head-of-line wait or burn
+        rate, Scheduler.should_preempt) and no free slot exists, demote
+        the policy's victims. Decision here on the loop thread (queue +
+        slot metadata only); the preempt op itself — drain the
+        pipelined tick, fold, demote KV, release the lease, park — runs
+        in the serialized executor stream like every other device-state
+        mutation."""
+        if self._free_slots():
+            return
+        head = self.pending.head_waiter()
+        if head is None:
+            return
+        waiter_class, wait_s = head
+        if not self.sched.should_preempt(waiter_class, wait_s):
+            return
+        active = [
+            (i, s.request.qos_class, s.request.tenant)
+            for i, s in enumerate(self.slots)
+            if s.active and s.request is not None
+        ]
+        victims = self.sched.victims(waiter_class, active)
+        if not victims:
+            return
+        try:
+            await loop.run_in_executor(None, self._preempt_slots, victims)
+        except asyncio.CancelledError:
+            raise  # batcher shutdown cancels the loop task
+        except Exception:
+            # _preempt_slots degrades per-slot and should never raise;
+            # if it somehow does, the slots are in an unknown state —
+            # the tick-failure recovery (replay everyone) is the
+            # correct big hammer.
+            logger.exception("preemption failed; recovering")
+            self._recover_after_tick_failure()
+
+    def _preempt_slots(self, victims: list[int]) -> None:
+        """Demote-don't-kill (executor thread): for each victim slot,
+        drain the pipelined tick, fold the emitted tokens into the
+        prompt (the _replay_or_fail fold WITHOUT burning a tick retry —
+        preemption is policy, not failure), park the valid KV pages as
+        evictable cache + host-tier copies (pages.demote_for_preempt),
+        release the adapter-arena pin, and park the request in its
+        class's resume lane. The grammar handle is KEPT — the resuming
+        activation re-derives the DFA state from the replay prefix
+        (_g0), exactly like a tick-failure replay, which is why greedy
+        output through a preempt cycle is bit-identical to the
+        uninterrupted run (the invariant the sched chaos suite
+        asserts). A `sched_preempt_fail` failpoint (or any unexpected
+        error) degrades TYPED: the victim keeps decoding unharmed and
+        sched_preempt_failures counts it — a failed preemption must
+        never hurt the request it tried to evict."""
+        # Collect in-flight pipelined ticks first: a dispatched tick
+        # still writes the victim's KV row and emits its tokens — the
+        # fold below must see the final acc, and no device write may
+        # land on a parked slot.
+        self._drain_inflight()
+        for sl in victims:
+            slot = self.slots[sl]
+            request = slot.request
+            if not slot.active or request is None or request.cancelled:
+                # Finished (or its consumer left) while the decision
+                # was in flight — nothing to demote; the normal
+                # terminal path owns the cleanup.
+                continue
+            try:
+                failpoints.evaluate("sched_preempt_fail")
+                fresh = request.acc[request.absorbed:]
+                if fresh:
+                    request.prompt = (
+                        list(request.prompt) + [int(t) for t in fresh]
+                    )
+                    request.max_new -= len(fresh)
+                    request.absorbed = len(request.acc)
+                if self._paged:
+                    self.pages.demote_for_preempt(
+                        sl, request.prompt, adapter=request.adapter_key
+                    )
+                    self._tables_dirty = True
+            except failpoints.FailpointError:
+                self.sched.preempt_failures += 1
+                logger.warning(
+                    "preemption failed for slot %d (injected); victim "
+                    "keeps decoding", sl,
+                )
+                continue
+            except Exception:
+                # Past the failpoint the sequence is host bookkeeping
+                # only (numpy index/refcount walks; the D2H inside
+                # demote_for_preempt is best-effort internally), so
+                # this is unexpected — degrade like the failpoint, but
+                # free the slot's pages defensively (free_slot is a
+                # no-op on an already-cleared row) and replay the
+                # request through the failure path, which burns a
+                # retry: the slot's page state is not trustworthy
+                # enough to keep decoding on.
+                logger.exception("preemption failed for slot %d", sl)
+                self.sched.preempt_failures += 1
+                if self._paged:
+                    self.pages.free_slot(sl)
+                    self._tables_dirty = True
+                slot.active = False
+                slot.request = None
+                slot.done = False
+                self.jump_ok[sl] = False
+                self.temps[sl] = 0.0
+                self.adapter_ids[sl] = 0
+                self.gstates[sl] = 0
+                self._slot_last_emit[sl] = None
+                self._loop_ref.call_soon_threadsafe(
+                    self._replay_or_fail, request
+                )
+                continue
+            # Release the arena pin so the row is evictable while the
+            # request is parked (resume reacquires — possibly a
+            # DIFFERENT row; the stable adapter_key keeps the KV
+            # domain). Static mode / base rows have no lease.
+            if request.adapter_lease is not None:
+                self.engine.adapter_arena.release(request.adapter_lease)
+                request.adapter_lease = None
+            # Park the slot exactly like _jump_degrade.
+            slot.active = False
+            slot.request = None
+            slot.done = False
+            self.jump_ok[sl] = False
+            self.temps[sl] = 0.0
+            self.adapter_ids[sl] = 0
+            self.gstates[sl] = 0
+            self._slot_last_emit[sl] = None
+            request.preempts += 1
+            request.parked = True
+            # Fresh queue clock: park time is scheduler-imposed wait,
+            # not the caller's original queue time — and the sweep's
+            # queue_deadline_ms must not expire a request the system
+            # already invested a prefill in because it parked too long.
+            request.t_submit = time.perf_counter()
+            self.sched.preemptions += 1
+            self._loop_ref.call_soon_threadsafe(
+                self._park_preempted, request
+            )
+
+    def _park_preempted(self, request: _Request) -> None:
+        """Loop-thread tail of a preemption: the parked request enters
+        its class's resume lane (head — its host-tier pages are the
+        hottest)."""
+        if request.cancelled:
+            self._record_terminal(request, "cancelled")
+            request.out.put_nowait(([], "cancelled"))
+            return
+        self.pending.park_preempted(request)
+        self._wake.set()
+
+    def _resume_reacquire(
+        self, slots_idx: list[int], batch: list[_Request]
+    ) -> None:
+        """Executor-side pre-pass of _prefill_into_slots (scheduler
+        on): a resuming request whose adapter pin was released at
+        preemption reacquires a row HERE, inside the serialized stream
+        where the arena's H2D factor write is safe — before the paged
+        pre-pass builds any block table. Rows that cannot reacquire
+        are FILTERED from the batch in place (slots_idx/batch are the
+        admission's own lists, so _admit's failure handling never sees
+        the dropped rows): arena pressure re-parks the request for the
+        next cycle, bounded by scheduler.resume_retry_limit attempts
+        before a typed "overloaded" shed — parking is a bounded
+        promise, not a black hole. Unknown/unloadable adapters (the
+        registry changed while parked) die typed as "error"."""
+        arena = getattr(self.engine, "adapter_arena", None)
+        keep_slots: list[int] = []
+        keep_batch: list[_Request] = []
+        for sl, request in zip(slots_idx, batch):
+            if (
+                request.preempts > 0
+                and request.adapter_key
+                and request.adapter_lease is None
+                and arena is not None
+            ):
+                try:
+                    lease = arena.acquire(request.adapter_key)
+                except AdapterExhaustedError:
+                    request.sched_retries += 1
+                    if request.sched_retries > int(
+                        self.sched_cfg.resume_retry_limit
+                    ):
+                        self.shed += 1
+                        self._record_terminal(request, "overloaded")
+                        self._loop_ref.call_soon_threadsafe(
+                            request.out.put_nowait, ([], "overloaded")
+                        )
+                    else:
+                        self._loop_ref.call_soon_threadsafe(
+                            self._repark, request
+                        )
+                    continue
+                except Exception:
+                    logger.exception(
+                        "resume: adapter %r reacquire failed",
+                        request.adapter_key,
+                    )
+                    self._record_terminal(request, "error")
+                    self._loop_ref.call_soon_threadsafe(
+                        request.out.put_nowait, ([], "error")
+                    )
+                    continue
+                request.adapter_lease = lease
+                # The row may DIFFER from the pre-preemption one —
+                # adapter_key (not the row id) keys the KV chains, so
+                # the parked pages are still this adapter's pages.
+                request.adapter = lease.row
+            keep_slots.append(sl)
+            keep_batch.append(request)
+        slots_idx[:] = keep_slots
+        batch[:] = keep_batch
+
+    def _repark(self, request: _Request) -> None:
+        """Loop-thread re-park after a failed resume attempt: BACK of
+        the class's resume lane (put_nowait routes on `parked`), so
+        sibling parked requests get their attempt before this one
+        retries."""
+        if request.cancelled:
+            self._record_terminal(request, "cancelled")
+            request.out.put_nowait(([], "cancelled"))
+            return
+        self.pending.put_nowait(request)
+        self._wake.set()
+
     def _recover_after_tick_failure(self) -> None:
         """Tick-failure recovery. The failed call donated the shared
         cache (and any interleave mini), so device state is gone — but
@@ -3223,6 +3518,15 @@ class ContinuousBatcher:
         deadline = time.monotonic() + self.cfg.max_queue_delay_ms / 1000.0
         loop = asyncio.get_running_loop()
         capped = False
+        # Sarathi-style tick-time control knob (scheduler on): cap the
+        # prefill tokens one _admit call may pull in while decodes are
+        # live, so a wave of long prompts never stalls in-flight
+        # interactive TPOT for more than one budgeted round.
+        prefill_budget = (
+            int(self.sched_cfg.prefill_budget_tokens)
+            if self.sched is not None else 0
+        )
+        tok_sum = 0
         while self._free_slots() and not capped:
             batch: list[_Request] = []
             budget = len(self._free_slots())
@@ -3270,7 +3574,24 @@ class ContinuousBatcher:
                     self._record_terminal(request, "timeout")
                     request.out.put_nowait(([], "timeout"))
                     continue
+                if (
+                    prefill_budget > 0
+                    and self._active_count() > 0
+                    and (batch or admitted)
+                    and tok_sum + len(request.prompt) > prefill_budget
+                ):
+                    # Over budget for this round: head-of-queue defer
+                    # (it pops first next cycle, against a fresh
+                    # budget). The (batch or admitted) guard admits at
+                    # least one request per call — a single prompt
+                    # larger than the whole budget must degrade to
+                    # one-at-a-time admission, never starve.
+                    self.pending.requeue_front(request)
+                    self.sched.budget_deferrals += 1
+                    capped = True
+                    break
                 batch.append(request)
+                tok_sum += len(request.prompt)
             if not batch:
                 break
             slots_idx = self._free_slots()[: len(batch)]
@@ -3404,6 +3725,13 @@ class ContinuousBatcher:
         # _admit's blast-radius-scaled batch-failure handling.
         failpoints.evaluate("admit_slow")
         failpoints.evaluate("admit_fail")
+        if self.sched is not None:
+            # Resume pre-pass: reacquire released adapter pins (and
+            # filter rows that cannot) BEFORE any block table or cache
+            # row is touched for them.
+            self._resume_reacquire(slots_idx, batch)
+            if not batch:
+                return
         t0 = time.perf_counter()
         fused_slots: list[int] = []
         fused_batch: list[_Request] = []

@@ -738,6 +738,39 @@ class PageAllocator:
                     self._free.append(page)
         row[:] = self.sentinel
 
+    def demote_for_preempt(self, slot: int, prompt: list,
+                           adapter: str = "") -> int:
+        """Park a preempted slot's KV: index the slot's VALID pages,
+        release the slot, and proactively copy the parked chain to the
+        host tier. `prompt` is the preemption-time effective prompt
+        (original prompt + accepted tokens, the replay fold); only its
+        first `len(prompt) - 1` positions have written KV — the newest
+        accepted token's KV is unwritten until the next tick, the same
+        `limit = len(prompt) - 1` reuse cap admit() applies — so the
+        registration covers exactly that prefix's full pages.
+
+        The pages STAY indexed as evictable cache: if pressure never
+        comes, the resume's admit() hits them on device for free; if
+        eviction does come, `host.has` dedup makes it demote-free (the
+        copy below already paid the D2H) and the resume restores with
+        one batched H2D — the proven PR 14 path. Best-effort like all
+        demotion: a D2H failure degrades to plain eviction-and-
+        recompute, never an error. Returns the number of chain pages
+        parked (0 = nothing page-aligned survived; resume recomputes,
+        bit-identically)."""
+        kept = prompt[:max(0, len(prompt) - 1)]
+        self.register(slot, kept, adapter)
+        chain = self.chain_pages(kept, adapter)
+        self.free_slot(slot)
+        if chain:
+            # Shared-prefix pages still referenced by OTHER slots skip
+            # the copy — they demote via _reclaim when they go ref-0.
+            self._demote([
+                page for page in chain
+                if self._ref[page] == 0 and page in self._key_of
+            ])
+        return len(chain)
+
     def reset(self) -> None:
         """Arena rebuilt from zeros (tick-failure recovery): every page
         and every index entry is device-dead — forget it all. Victims

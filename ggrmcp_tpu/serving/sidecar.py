@@ -45,6 +45,7 @@ from ggrmcp_tpu.serving.batching import (
     OverloadedError,
 )
 from ggrmcp_tpu.serving.pages import PageExhaustedError
+from ggrmcp_tpu.serving.scheduler import retry_after_for
 from ggrmcp_tpu.serving.engine import EmbeddingEngine, GenerationEngine
 from ggrmcp_tpu.serving.tokenizer import ByteTokenizer, load_tokenizer
 from ggrmcp_tpu.utils import failpoints, tracing
@@ -292,6 +293,18 @@ class Sidecar:
             top_p=s.top_p if 0.0 < s.top_p < 1.0 else 1.0,
         )
 
+    def _retry_after(self, qos_class: str) -> float:
+        """The per-QoS-class Retry-After (serving/scheduler.py ladder):
+        encoded into RESOURCE_EXHAUSTED details as "retry in Ns" so the
+        gateway's 429 carries a class-appropriate backoff — background
+        sheds wait geometrically longer than interactive ones, and the
+        retry storm cooperates with the scheduler's priority order.
+        Falls back to the flat 1 s contract when the batcher carries no
+        scheduler config (tiered facade, bare test rigs)."""
+        return retry_after_for(
+            getattr(self.batcher, "sched_cfg", None), qos_class
+        )
+
     async def _resolve_adapter(self, request, context):
         """GenerateRequest.adapter name → (served LoRA row id, arena
         lease or None). Static (boot-time) mode resolves against the
@@ -330,7 +343,8 @@ class Sidecar:
         except AdapterExhaustedError as exc:
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
-                f"server overloaded (adapters): {exc}",
+                f"server overloaded (adapters): {exc}; "
+                f"retry in {self._retry_after(''):g}s",
             )
         except AdapterLoadError as exc:
             await context.abort(grpc.StatusCode.ABORTED, str(exc))
@@ -523,7 +537,8 @@ class Sidecar:
                     self._release_adapter(lease)
                     await context.abort(
                         grpc.StatusCode.RESOURCE_EXHAUSTED,
-                        f"server overloaded ({exc.reason}): {exc}",
+                        f"server overloaded ({exc.reason}): {exc}; "
+                        f"retry in {exc.retry_after_s:g}s",
                     )
                 except GrammarCapacityError as exc:
                     # Too many DISTINCT schemas decoding at once —
@@ -545,7 +560,8 @@ class Sidecar:
             # here, HTTP 429 + Retry-After at the gateway.
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
-                "server overloaded (pages): kv page pool exhausted",
+                f"server overloaded (pages): kv page pool exhausted; "
+                f"retry in {self._retry_after(qos_class):g}s",
             )
         if finish == "error":
             await context.abort(
@@ -635,7 +651,8 @@ class Sidecar:
             self._release_adapter(lease)
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
-                f"server overloaded ({exc.reason}): {exc}",
+                f"server overloaded ({exc.reason}): {exc}; "
+                        f"retry in {exc.retry_after_s:g}s",
             )
         except GrammarCapacityError as exc:
             self._release_adapter(lease)
@@ -665,8 +682,9 @@ class Sidecar:
                     # ladder as a submit-time OverloadedError.
                     await context.abort(
                         grpc.StatusCode.RESOURCE_EXHAUSTED,
-                        "server overloaded (pages): kv page pool "
-                        "exhausted",
+                        f"server overloaded (pages): kv page pool "
+                        f"exhausted; retry in "
+                        f"{self._retry_after(qos_class):g}s",
                     )
                 if reason == "error":
                     # Same contract as unary Generate: a backend failure
@@ -830,7 +848,8 @@ class Sidecar:
             self._release_adapter(lease)
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
-                f"server overloaded ({exc.reason}): {exc}",
+                f"server overloaded ({exc.reason}): {exc}; "
+                        f"retry in {exc.retry_after_s:g}s",
             )
         async for _ids, reason in it:
             if reason:

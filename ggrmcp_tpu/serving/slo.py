@@ -98,7 +98,7 @@ class _ClassAccount:
 
     __slots__ = (
         "name", "ttft_target_ms", "tpot_target_ms",
-        "met", "violated", "unevaluated",
+        "met", "violated", "unevaluated", "sheds",
         "ttft", "tpot", "e2e", "ring",
     )
 
@@ -109,6 +109,10 @@ class _ClassAccount:
         self.met = 0
         self.violated = 0
         self.unevaluated = 0
+        # Submit-time sheds, per class (a SUBSET of unevaluated): the
+        # 429 path's class breakdown, so "who absorbs the damage under
+        # overload" is a counter, not an inference.
+        self.sheds = 0
         self.ttft = LatencyHistogram(bounds)
         self.tpot = LatencyHistogram(bounds)
         self.e2e = LatencyHistogram(bounds)
@@ -242,6 +246,7 @@ class SloAccount:
         c = self.classes[self.resolve(qos_class)]
         with self._lock:
             c.unevaluated += 1
+            c.sheds += 1
             self._stamp(c)
 
     def uncount_shed(self, qos_class: str) -> None:
@@ -257,7 +262,33 @@ class SloAccount:
         with self._lock:
             if c.unevaluated > 0:
                 c.unevaluated -= 1
+            if c.sheds > 0:
+                c.sheds -= 1
             self._stamp(c)
+
+    # -- scheduler read API -------------------------------------------------
+
+    def burn_rate(self, qos_class: str, window_s: Optional[float] = None) -> float:
+        """Current burn rate for one class over one window (default:
+        the FASTEST configured window — the scheduler wants the
+        early-warning signal, not the long-term trend). 0.0 when
+        disabled or when the window holds no baseline yet, so callers
+        can compare against a threshold without None-guards."""
+        if not self.enabled or not self.windows:
+            return 0.0
+        w = float(window_s) if window_s is not None else min(self.windows)
+        c = self.classes[self.resolve(qos_class)]
+        now = self._clock()
+        with self._lock:
+            dv, dt = c.window_delta(now, w)
+        return (dv / dt) / ERROR_BUDGET if dt > 0 else 0.0
+
+    def ttft_target_ms(self, qos_class: str) -> float:
+        """The class's TTFT objective (ms) — the scheduler's head-wait
+        yardstick. 0.0 when disabled (callers treat 0 as 'no target')."""
+        if not self.enabled:
+            return 0.0
+        return float(self.classes[self.resolve(qos_class)].ttft_target_ms)
 
     def _stamp(self, c: _ClassAccount) -> None:
         """Append/refresh the burn baseline ring (lock held). ~1 s
@@ -300,6 +331,7 @@ class SloAccount:
                     "met": c.met,
                     "violated": c.violated,
                     "unevaluated": c.unevaluated,
+                    "sheds": c.sheds,
                     "total_requests": c.total,
                     "ttft_ms_bucket": list(c.ttft.counts),
                     "ttft_ms_sum": c.ttft.sum,
@@ -349,7 +381,7 @@ class SloAccount:
                     order.append(name)
                     continue
                 m = merged[name]
-                for key in ("met", "violated", "unevaluated",
+                for key in ("met", "violated", "unevaluated", "sheds",
                             "total_requests", "ttft_ms_sum",
                             "ttft_ms_count", "tpot_ms_sum",
                             "tpot_ms_count", "e2e_ms_sum",
@@ -507,6 +539,32 @@ class TenantTable:
                 row.requests -= 1
             if row.shed > 0:
                 row.shed -= 1
+
+    # -- scheduler read API -------------------------------------------------
+
+    def shares(self) -> dict:
+        """Normalized VTC share per tenant (weighted tokens / grand
+        total), `~overflow` included when it has absorbed anything.
+        Shares sum to 1.0 whenever any weighted tokens exist (all-zero
+        table → all-zero shares), so the scheduler's fair-share order
+        conserves exactly what the accounting conserves. Cheap: one
+        lock hold to snapshot, arithmetic outside it. Empty dict when
+        disabled — the scheduler degrades to per-class FIFO."""
+        if not self.enabled:
+            return {}
+        with self._lock:
+            entries = [
+                (name, row.weighted_tokens)
+                for name, row in self._rows.items()
+            ]
+            if self._overflow.requests or self._overflow.weighted_tokens:
+                entries.append(
+                    (OVERFLOW_TENANT, self._overflow.weighted_tokens)
+                )
+        total = sum(w for _, w in entries)
+        if total <= 0:
+            return {name: 0.0 for name, _ in entries}
+        return {name: w / total for name, w in entries}
 
     # -- export -------------------------------------------------------------
 
